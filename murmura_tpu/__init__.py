@@ -1,0 +1,29 @@
+"""murmura_tpu — TPU-native decentralized federated learning.
+
+A from-scratch JAX/XLA framework with the capabilities of Cloudslab/murmura
+(reference: /root/reference/murmura/__init__.py:10-33): YAML-driven
+decentralized FL over configurable graph topologies with Byzantine-resilient
+aggregation, re-designed TPU-first:
+
+- every per-node quantity carries a leading ``nodes`` axis on stacked pytrees,
+- one FL round is a single jitted program (local SGD -> attack -> adjacency-
+  masked exchange -> vmapped robust aggregation -> eval),
+- the ``tpu`` backend shards the node axis over a ``jax.sharding.Mesh`` so the
+  neighbor exchange rides ICI collectives instead of ZeroMQ sockets.
+"""
+
+__version__ = "0.1.0"
+
+from murmura_tpu.config import Config, load_config, save_config
+from murmura_tpu.topology import Topology, create_topology
+from murmura_tpu.topology.dynamic import MobilityModel
+
+__all__ = [
+    "Config",
+    "load_config",
+    "save_config",
+    "Topology",
+    "create_topology",
+    "MobilityModel",
+    "__version__",
+]

@@ -1,0 +1,5 @@
+"""``python -m murmura_tpu`` entry point."""
+
+from murmura_tpu.cli import main
+
+main()

@@ -1,0 +1,58 @@
+"""Byzantine-resilient aggregation rules, vectorized over the node axis
+(reference: murmura/aggregation/)."""
+
+from typing import Any, Dict
+
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    AggregatorDef,
+    masked_neighbor_mean,
+    pairwise_l2_distances,
+)
+from murmura_tpu.aggregation.fedavg import make_fedavg
+from murmura_tpu.aggregation.krum import make_krum
+from murmura_tpu.aggregation.balance import make_balance
+from murmura_tpu.aggregation.sketchguard import make_sketchguard
+from murmura_tpu.aggregation.ubar import make_ubar
+from murmura_tpu.aggregation.evidential_trust import make_evidential_trust
+
+AGGREGATORS = {
+    "fedavg": make_fedavg,
+    "krum": make_krum,
+    "balance": make_balance,
+    "sketchguard": make_sketchguard,
+    "ubar": make_ubar,
+    "evidential_trust": make_evidential_trust,
+}
+
+
+def build_aggregator(
+    algorithm: str, params: Dict[str, Any], model_dim: int = 0, total_rounds: int = 20
+) -> AggregatorDef:
+    """Build a rule from config, injecting derived params the way the
+    reference factory does (murmura/utils/factories.py:83-88: sketchguard
+    gets model_dim; schedule-based rules use total_rounds via AggContext)."""
+    algo = algorithm.lower()
+    if algo not in AGGREGATORS:
+        raise ValueError(f"Unknown aggregation algorithm: {algorithm}")
+    params = dict(params or {})
+    params.pop("total_rounds", None)  # carried via AggContext instead
+    if algo == "sketchguard":
+        params.setdefault("model_dim", model_dim)
+    return AGGREGATORS[algo](**params)
+
+
+__all__ = [
+    "AggContext",
+    "AggregatorDef",
+    "AGGREGATORS",
+    "build_aggregator",
+    "make_fedavg",
+    "make_krum",
+    "make_balance",
+    "make_sketchguard",
+    "make_ubar",
+    "make_evidential_trust",
+    "pairwise_l2_distances",
+    "masked_neighbor_mean",
+]

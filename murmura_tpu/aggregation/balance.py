@@ -1,0 +1,79 @@
+"""BALANCE: adaptive distance filtering
+(reference: murmura/aggregation/balance.py:13-185).
+
+threshold_i(t) = gamma * exp(-kappa * t/T) * ||own_i||  (balance.py:82-89);
+accept neighbors with L2 distance <= threshold (balance.py:108-131);
+fallback-accept the closest neighbor when fewer than min_neighbors pass
+(balance.py:133-135); output alpha*own + (1-alpha)*mean(accepted), own state
+when nothing accepted (balance.py:140-175).
+"""
+
+import jax.numpy as jnp
+
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    AggregatorDef,
+    blend_with_own,
+    masked_neighbor_mean,
+    pairwise_l2_distances,
+)
+
+
+def accept_with_closest_fallback(
+    dist: jnp.ndarray,
+    adj: jnp.ndarray,
+    threshold: jnp.ndarray,
+    min_neighbors: int,
+) -> jnp.ndarray:
+    """Accepted-neighbor mask with the BALANCE closest-neighbor fallback.
+
+    Args:
+        dist: [N, N] own-to-broadcast distances (diagonal ignored).
+        adj: [N, N] 0/1 adjacency.
+        threshold: [N] per-node acceptance thresholds.
+        min_neighbors: fallback trigger (reference default 1, balance.py:133).
+
+    Returns:
+        [N, N] float mask of accepted neighbors.
+    """
+    adj_b = adj.astype(bool)
+    accepted = adj_b & (dist <= threshold[:, None])
+    count = accepted.sum(axis=1)
+    has_any_neighbor = adj_b.any(axis=1)
+    masked = jnp.where(adj_b, dist, jnp.inf)
+    closest = jnp.argmin(masked, axis=1)
+    fallback_row = (
+        jnp.zeros_like(accepted).at[jnp.arange(adj.shape[0]), closest].set(True)
+    )
+    use_fallback = (count < min_neighbors) & has_any_neighbor
+    accepted = jnp.where(use_fallback[:, None], accepted | fallback_row, accepted)
+    return accepted.astype(dist.dtype)
+
+
+def make_balance(
+    gamma: float = 2.0,
+    kappa: float = 1.0,
+    alpha: float = 0.5,
+    min_neighbors: int = 1,
+    **_params,
+) -> AggregatorDef:
+    def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        lambda_t = round_idx / jnp.maximum(1, ctx.total_rounds)
+        own_norm = jnp.sqrt(jnp.sum(own * own, axis=-1))
+        threshold = gamma * jnp.exp(-kappa * lambda_t) * own_norm
+
+        dist = pairwise_l2_distances(own, bcast)
+        accepted = accept_with_closest_fallback(dist, adj, threshold, min_neighbors)
+
+        neighbor_avg = masked_neighbor_mean(bcast, accepted)
+        has_accepted = accepted.sum(axis=1) > 0
+        new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
+
+        degree = jnp.maximum(adj.sum(axis=1), 1.0)
+        stats = {
+            "acceptance_rate": accepted.sum(axis=1) / degree,
+            "threshold": threshold,
+        }
+        return new_flat, state, stats
+
+    return AggregatorDef(name="balance", aggregate=aggregate)

@@ -1,0 +1,147 @@
+"""Aggregation rule interface and shared kernels.
+
+The reference's ``Aggregator.aggregate(node_id, own_state, neighbor_states,
+round_num)`` (murmura/aggregation/base.py:20-49) runs once per node per round
+over Python dicts.  Here a rule is one pure function over the whole network:
+
+    aggregate(own[N, P], bcast[N, P], adj[N, N], round_idx, state, ctx)
+        -> (new_flat[N, P], new_state, stats)
+
+- ``own`` holds each node's true state; ``bcast`` holds the states as
+  broadcast (post-attack).  The two differ only on compromised rows — the
+  reference aggregates with the node's own true state while neighbors see
+  the attacked snapshot (murmura/core/network.py:108-135, node.py:214-252);
+- ``adj`` is the 0/1 adjacency mask of the gathered neighbor tensor;
+- ``state`` carries cross-round per-rule memory (EMA trust, acceptance
+  windows) that the reference keeps as Python attributes
+  (e.g. evidential_trust.py:112-113, sketchguard.py:61-64);
+- ``stats`` are per-node arrays replacing ``get_statistics()`` scalars.
+
+Everything is traced — the rule compiles into the jitted round step.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Stats = Dict[str, jnp.ndarray]
+AggState = Dict[str, jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class AggContext:
+    """Per-round context handed to aggregation rules.
+
+    Attributes:
+        apply_fn: single-model forward (params, x, key, train) -> outputs.
+        unravel: flat [P] -> params pytree.
+        probe_x/probe_y/probe_mask: per-node probe batches [N, B, ...] used by
+            loss-probe rules (UBAR stage 2 — ubar.py:152-202) and trust
+            evaluation (evidential_trust.py:214-316).
+        evidential: whether apply_fn outputs Dirichlet alphas.
+        num_classes: output arity (for losses).
+        total_rounds: T for threshold schedules.
+    """
+
+    apply_fn: Callable = None
+    unravel: Callable = None
+    probe_x: Optional[jnp.ndarray] = None
+    probe_y: Optional[jnp.ndarray] = None
+    probe_mask: Optional[jnp.ndarray] = None
+    evidential: bool = False
+    num_classes: int = 0
+    total_rounds: int = 1
+
+
+@dataclass(frozen=True)
+class AggregatorDef:
+    """A named aggregation rule with optional carried state."""
+
+    name: str
+    aggregate: Callable[
+        [jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, AggState, AggContext],
+        Tuple[jnp.ndarray, AggState, Stats],
+    ]
+    init_state: Callable[[int], AggState] = field(default=lambda num_nodes: {})
+    needs_probe: bool = False
+
+
+# ---------------------------------------------------------------------------
+# Shared kernels
+# ---------------------------------------------------------------------------
+
+
+def pairwise_l2_distances(
+    a: jnp.ndarray, b: Optional[jnp.ndarray] = None
+) -> jnp.ndarray:
+    """L2 distance matrix D[i, j] = ||a_i - b_j|| via one Gram matmul.
+
+    With ``b=None`` this is the all-pairs matrix over one tensor. The
+    reference instead recomputes per-pair distances inside each node's
+    Python loop (krum.py:54-62, balance.py:99-106).
+
+    Numerics: the rows are centered on the mean of ``a`` before the Gram
+    identity.  Late in training all nodes' parameter vectors cluster around
+    a common point with norms orders of magnitude larger than their pairwise
+    distances; without centering, sq_a + sq_b - 2ab cancels catastrophically
+    in float32 and Krum's small-distance ranking degrades to rounding noise.
+    Centering leaves distances unchanged and shrinks the norms to the
+    cluster scale.
+    """
+    if b is None:
+        b = a
+    center = jnp.mean(a, axis=0, keepdims=True)
+    a = a - center
+    b = b - center
+    sq_a = jnp.sum(a * a, axis=-1)
+    sq_b = jnp.sum(b * b, axis=-1)
+    d2 = sq_a[:, None] + sq_b[None, :] - 2.0 * (a @ b.T)
+    return jnp.sqrt(jnp.maximum(d2, 0.0))
+
+
+def masked_neighbor_mean(bcast: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
+    """Weighted neighbor mean per node: (W @ bcast) / row-sum, safe on empty rows."""
+    totals = weights.sum(axis=1, keepdims=True)
+    return (weights @ bcast) / jnp.maximum(totals, 1e-12)
+
+
+def blend_with_own(
+    own: jnp.ndarray,
+    neighbor_avg: jnp.ndarray,
+    has_neighbors: jnp.ndarray,
+    alpha: float,
+) -> jnp.ndarray:
+    """alpha*own + (1-alpha)*neighbor_avg where any neighbor was accepted,
+    else own (the BALANCE/Sketchguard/UBAR output form — balance.py:140-175)."""
+    blended = alpha * own + (1.0 - alpha) * neighbor_avg
+    return jnp.where(has_neighbors[:, None], blended, own)
+
+
+def rank_mask(values: jnp.ndarray, valid: jnp.ndarray, k: jnp.ndarray) -> jnp.ndarray:
+    """Boolean mask of the k smallest valid entries per row.
+
+    Args:
+        values: [..., M] scores (smaller = better).
+        valid: [..., M] candidate mask.
+        k: [...] per-row number to keep.
+    """
+    masked = jnp.where(valid, values, jnp.inf)
+    order = jnp.argsort(masked, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    return valid & (ranks < k[..., None])
+
+
+def self_probe_metrics(
+    own: jnp.ndarray, ctx: AggContext, metric_fn: Callable
+) -> Dict[str, jnp.ndarray]:
+    """Evaluate each node's own params on its own probe batch (diagonal of the
+    cross-eval), e.g. UBAR's own-loss baseline (ubar.py:174-176)."""
+
+    def one(flat_i, x_i, y_i, m_i):
+        params = ctx.unravel(flat_i)
+        outputs = ctx.apply_fn(params, x_i, None, False)
+        return metric_fn(outputs, y_i, m_i)
+
+    return jax.vmap(one)(own, ctx.probe_x, ctx.probe_y, ctx.probe_mask)

@@ -1,0 +1,19 @@
+"""FedAvg: equal-weight mean of own + neighbor states
+(reference: murmura/aggregation/fedavg.py:19-42).
+
+Vectorized over the whole network: own state plus one adjacency matmul over
+the broadcast tensor, normalized by 1 + degree.
+"""
+
+import jax.numpy as jnp
+
+from murmura_tpu.aggregation.base import AggContext, AggregatorDef
+
+
+def make_fedavg(**_params) -> AggregatorDef:
+    def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        degree = adj.sum(axis=1)
+        new_flat = (own + adj @ bcast) / (1.0 + degree)[:, None]
+        return new_flat, state, {"num_neighbors": degree}
+
+    return AggregatorDef(name="fedavg", aggregate=aggregate)

@@ -1,0 +1,72 @@
+"""Batched cross-evaluation of every node's model on every node's probe data.
+
+This replaces the reference's most expensive pattern: per neighbor, deep-copy
+a module, load_state_dict, and loop batches (ubar.py:175-188,
+evidential_trust.py:236-260, dmtt/node_process.py:309-363).  Here the gathered
+[N, P] tensor is already on-device, so "evaluate neighbor j on my data" is a
+batched forward: for each parameter row j, one forward over ALL nodes' probe
+batches at once ([N*B] samples — one big MXU-friendly matmul), scanned over j
+to bound memory at O(N * B * K) per step.
+"""
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+from murmura_tpu.aggregation.base import AggContext
+
+
+def pairwise_probe_eval(
+    flat: jnp.ndarray,
+    ctx: AggContext,
+    metric_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], Dict[str, jnp.ndarray]],
+) -> Dict[str, jnp.ndarray]:
+    """Evaluate model j on node i's probe batch for all (i, j).
+
+    Args:
+        flat: [N, P] gathered flattened params.
+        ctx: aggregation context with probe_x [N, B, ...], probe_y [N, B],
+            probe_mask [N, B].
+        metric_fn: (outputs [B, K], y [B], mask [B]) -> dict of scalar metrics.
+
+    Returns:
+        dict of [N, N] arrays, entry [i, j] = metric of model j on node i's data.
+    """
+    n, b = ctx.probe_x.shape[:2]
+    xs = ctx.probe_x.reshape((n * b,) + ctx.probe_x.shape[2:])
+
+    def eval_one_model(flat_j: jnp.ndarray) -> Dict[str, jnp.ndarray]:
+        params = ctx.unravel(flat_j)
+        outputs = ctx.apply_fn(params, xs, None, False)  # [N*B, K]
+        outputs = outputs.reshape(n, b, -1)
+        return jax.vmap(metric_fn)(outputs, ctx.probe_y, ctx.probe_mask)
+
+    # scan over models j -> dict of [N_j, N_i]; transpose to [N_i, N_j].
+    per_j = jax.lax.map(eval_one_model, flat)
+    return {k: v.T for k, v in per_j.items()}
+
+
+def ce_loss_metric(outputs, y, mask):
+    """Masked mean CE loss (UBAR stage-2 probe — ubar.py:204-222)."""
+    logp = jax.nn.log_softmax(outputs, axis=-1)
+    nll = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+    return {"loss": (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)}
+
+
+def evidential_trust_metric(outputs, y, mask):
+    """Masked accuracy + mean vacuity of Dirichlet outputs
+    (evidential_trust.py:249-287)."""
+    denom = jnp.maximum(mask.sum(), 1.0)
+    s = outputs.sum(-1)
+    k = outputs.shape[-1]
+    vacuity = ((k / s) * mask).sum() / denom
+    acc = ((jnp.argmax(outputs, -1) == y).astype(jnp.float32) * mask).sum() / denom
+    entropy_per = -(
+        (outputs / outputs.sum(-1, keepdims=True))
+        * jnp.log(outputs / outputs.sum(-1, keepdims=True) + 1e-10)
+    ).sum(-1)
+    entropy = (entropy_per * mask).sum() / denom
+    strength = (s * mask).sum() / denom
+    return {"accuracy": acc, "vacuity": vacuity, "entropy": entropy,
+            "strength": strength}

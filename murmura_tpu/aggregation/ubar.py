@@ -1,0 +1,74 @@
+"""UBAR: two-stage Byzantine-resilient aggregation
+(reference: murmura/aggregation/ubar.py:15-271).
+
+Stage 1 — distance shortlist: keep max(min_neighbors, floor(rho * degree))
+closest neighbors by L2 (ubar.py:114-150).
+Stage 2 — loss probe: keep shortlisted neighbors whose loss on one local
+training batch is <= own loss; fallback to the best-loss candidate when none
+pass (ubar.py:152-202).  Output alpha*own + (1-alpha)*mean (ubar.py:224-249).
+
+TPU shape: stage 2's per-neighbor load_state_dict loop becomes one batched
+cross-evaluation of the gathered [N, P] tensor (see aggregation/probe.py);
+the own-loss baseline is the vmapped diagonal over the true own states.
+"""
+
+import jax.numpy as jnp
+
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    AggregatorDef,
+    blend_with_own,
+    masked_neighbor_mean,
+    pairwise_l2_distances,
+    rank_mask,
+    self_probe_metrics,
+)
+from murmura_tpu.aggregation.probe import ce_loss_metric, pairwise_probe_eval
+
+
+def make_ubar(
+    rho: float = 0.4,
+    alpha: float = 0.5,
+    min_neighbors: int = 1,
+    **_params,
+) -> AggregatorDef:
+    def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        n = own.shape[0]
+        adj_b = adj.astype(bool)
+        degree = adj.sum(axis=1)
+
+        # Stage 1: rho * degree closest neighbors (ubar.py:133-139).
+        dist = pairwise_l2_distances(own, bcast)
+        num_select = jnp.maximum(min_neighbors, (rho * degree).astype(jnp.int32))
+        shortlist = rank_mask(dist, adj_b, num_select)
+
+        # Stage 2: loss probe on one local batch (ubar.py:152-202).
+        losses = pairwise_probe_eval(bcast, ctx, ce_loss_metric)["loss"]  # [N_i, N_j]
+        own_loss = self_probe_metrics(own, ctx, ce_loss_metric)["loss"]  # [N]
+        passed = shortlist & (losses <= own_loss[:, None])
+
+        # Fallback: best-loss shortlisted candidate when none pass
+        # (ubar.py:195-197).
+        shortlist_losses = jnp.where(shortlist, losses, jnp.inf)
+        best = jnp.argmin(shortlist_losses, axis=1)
+        fallback = jnp.zeros_like(passed).at[jnp.arange(n), best].set(True) & shortlist
+        has_shortlist = shortlist.any(axis=1)
+        none_passed = ~passed.any(axis=1)
+        accepted = jnp.where(
+            (none_passed & has_shortlist)[:, None], fallback, passed
+        ).astype(own.dtype)
+
+        neighbor_avg = masked_neighbor_mean(bcast, accepted)
+        has_accepted = accepted.sum(axis=1) > 0
+        new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
+
+        deg_safe = jnp.maximum(degree, 1.0)
+        shortlist_count = jnp.maximum(shortlist.sum(axis=1).astype(own.dtype), 1.0)
+        stats = {
+            "stage1_acceptance_rate": shortlist.sum(axis=1) / deg_safe,
+            "stage2_acceptance_rate": accepted.sum(axis=1) / shortlist_count,
+            "own_loss": own_loss,
+        }
+        return new_flat, state, stats
+
+    return AggregatorDef(name="ubar", aggregate=aggregate, needs_probe=True)

@@ -1,0 +1,22 @@
+"""Byzantine attack simulation (reference: murmura/attacks/)."""
+
+from murmura_tpu.attacks.base import Attack, select_compromised
+from murmura_tpu.attacks.gaussian import make_gaussian_attack
+from murmura_tpu.attacks.directed import make_directed_deviation_attack
+from murmura_tpu.attacks.topology_liar import make_topology_liar_attack, false_claims
+
+ATTACKS = {
+    "gaussian": make_gaussian_attack,
+    "directed_deviation": make_directed_deviation_attack,
+    "topology_liar": make_topology_liar_attack,
+}
+
+__all__ = [
+    "Attack",
+    "select_compromised",
+    "make_gaussian_attack",
+    "make_directed_deviation_attack",
+    "make_topology_liar_attack",
+    "false_claims",
+    "ATTACKS",
+]

@@ -1,0 +1,58 @@
+"""Attack interface and compromised-node selection
+(reference: murmura/attacks/base.py:8-52).
+
+An attack is a pure transform of the *outgoing* broadcast states:
+``apply(flat[N, P], compromised[N], key, round_idx) -> flat'`` — honest rows
+pass through untouched.  Compromised nodes additionally skip local training
+(frozen models) exactly as in the reference (murmura/core/network.py:99-101);
+that masking lives in the round step, keyed off the same mask produced here.
+"""
+
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def select_compromised(num_nodes: int, percentage: float, seed: int = 42) -> np.ndarray:
+    """Seeded compromised-node selection with the reference's exact rule
+    (gaussian.py:36-44): ceil-to-1 when percentage > 0, ``random.sample``
+    under ``random.seed(seed)``.
+
+    Returns:
+        [N] boolean mask.
+    """
+    num = int(num_nodes * percentage)
+    if num == 0 and percentage > 0:
+        num = 1
+    rng = random.Random(seed)
+    chosen = rng.sample(range(num_nodes), min(num, num_nodes)) if num > 0 else []
+    mask = np.zeros(num_nodes, dtype=bool)
+    mask[list(chosen)] = True
+    return mask
+
+
+@dataclass(frozen=True)
+class Attack:
+    """A named attack with its compromised set and pure state transform."""
+
+    name: str
+    compromised: np.ndarray  # [N] bool
+    apply: Callable[
+        [jnp.ndarray, jnp.ndarray, Optional[jax.Array], jnp.ndarray], jnp.ndarray
+    ]
+    # DMTT topology-liar claims hook (None for model-only attacks)
+    claims_fn: Optional[Callable] = field(default=None)
+
+    def is_compromised(self, node_id: int) -> bool:
+        return bool(self.compromised[node_id])
+
+    def get_compromised_nodes(self) -> set:
+        return set(np.flatnonzero(self.compromised).tolist())
+
+    @property
+    def honest_mask(self) -> np.ndarray:
+        return ~self.compromised

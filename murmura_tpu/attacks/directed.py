@@ -1,0 +1,23 @@
+"""Directed deviation attack (reference: murmura/attacks/directed.py:10-89).
+
+Compromised nodes broadcast lambda * state (default lambda = -5.0: push in
+the opposite direction, amplified).
+"""
+
+import jax.numpy as jnp
+
+from murmura_tpu.attacks.base import Attack, select_compromised
+
+
+def make_directed_deviation_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    lambda_param: float = -5.0,
+    seed: int = 42,
+) -> Attack:
+    compromised = select_compromised(num_nodes, attack_percentage, seed)
+
+    def apply(flat, compromised_mask, key, round_idx):
+        return jnp.where(compromised_mask[:, None] > 0, lambda_param * flat, flat)
+
+    return Attack(name="directed_deviation", compromised=compromised, apply=apply)

@@ -1,0 +1,26 @@
+"""Gaussian noise attack (reference: murmura/attacks/gaussian.py:10-90).
+
+Compromised nodes broadcast state + N(0, noise_std^2) noise; all parameters
+here are float (no BatchNorm integer buffers — see models/core.py), so the
+reference's dtype special-casing (gaussian.py:82-88) has no counterpart.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from murmura_tpu.attacks.base import Attack, select_compromised
+
+
+def make_gaussian_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    noise_std: float = 10.0,
+    seed: int = 42,
+) -> Attack:
+    compromised = select_compromised(num_nodes, attack_percentage, seed)
+
+    def apply(flat, compromised_mask, key, round_idx):
+        noise = jax.random.normal(key, flat.shape, flat.dtype) * noise_std
+        return jnp.where(compromised_mask[:, None] > 0, flat + noise, flat)
+
+    return Attack(name="gaussian", compromised=compromised, apply=apply)

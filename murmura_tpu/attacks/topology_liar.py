@@ -1,0 +1,60 @@
+"""Topology liar attack for DMTT (reference: murmura/attacks/topology_liar.py:14-102).
+
+Liars optionally poison their broadcast model via a wrapped inner attack
+(topology_liar.py:57-72) and falsify their TOPO_CLAIM: the claimed neighbor
+set is the true G^t neighbors UNION all other Byzantine nodes
+(topology_liar.py:78-102), inflating the apparent connectivity of the
+Byzantine coalition.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from murmura_tpu.attacks.base import Attack, select_compromised
+
+
+def false_claims(
+    true_adj: jnp.ndarray, compromised_mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Claimed-adjacency tensor [N, N]: row i is node i's TOPO_CLAIM.
+
+    Honest rows equal the true adjacency; liar rows add every other
+    compromised node (reference: topology_liar.py:78-102).
+    """
+    comp = compromised_mask > 0
+    coalition = comp[None, :] & comp[:, None]
+    coalition = coalition & ~jnp.eye(true_adj.shape[0], dtype=bool)
+    liar_rows = (true_adj > 0) | coalition
+    return jnp.where(comp[:, None], liar_rows, true_adj > 0).astype(true_adj.dtype)
+
+
+def make_topology_liar_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    seed: int = 42,
+    model_attack: Optional[Attack] = None,
+) -> Attack:
+    compromised = select_compromised(num_nodes, attack_percentage, seed)
+    if model_attack is not None:
+        # Share the liar's compromised set so poisoning and lying coincide.
+        model_attack = Attack(
+            name=model_attack.name,
+            compromised=compromised,
+            apply=model_attack.apply,
+        )
+
+    def apply(flat, compromised_mask, key, round_idx):
+        """Model poisoning is delegated to the wrapped inner attack
+        (topology_liar.py:57-72); pure liars broadcast honest states."""
+        if model_attack is None:
+            return flat
+        return model_attack.apply(flat, compromised_mask, key, round_idx)
+
+    return Attack(
+        name="topology_liar",
+        compromised=compromised,
+        apply=apply,
+        claims_fn=false_claims,
+    )

@@ -1,0 +1,138 @@
+"""Click CLI (reference: murmura/cli.py:34-308, a typer app; this
+environment ships click, which typer wraps, so the commands are plain click).
+
+Commands: ``run`` (simulation / tpu / distributed by config.backend),
+``run-node`` (multi-machine ZMQ worker), ``list-components``.
+"""
+
+import json
+from pathlib import Path
+
+import click
+from rich.console import Console
+from rich.table import Table
+
+from murmura_tpu.config import load_config
+from murmura_tpu.utils.seed import set_seed
+
+console = Console()
+
+
+@click.group()
+def app():
+    """murmura_tpu: TPU-native decentralized federated learning."""
+
+
+@app.command()
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--verbose/--quiet", "verbose", default=None, help="Override config verbosity")
+@click.option("--output", "-o", type=click.Path(path_type=Path), default=None,
+              help="Write history JSON here")
+def run(config_path: Path, verbose, output):
+    """Run an experiment from a config file (reference: cli.py:34-60)."""
+    config = load_config(config_path)
+    if verbose is not None:
+        config.experiment.verbose = verbose
+
+    console.print(
+        f"[bold cyan]murmura_tpu[/bold cyan] experiment "
+        f"[bold]{config.experiment.name}[/bold] "
+        f"(backend={config.backend}, nodes={config.topology.num_nodes}, "
+        f"rounds={config.experiment.rounds})"
+    )
+    set_seed(config.experiment.seed)
+
+    if config.backend == "distributed":
+        from murmura_tpu.distributed.runner import DistributedRunner
+
+        history = DistributedRunner(config).run()
+    else:
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        network = build_network_from_config(config)
+        history = network.train(
+            rounds=config.experiment.rounds,
+            verbose=config.experiment.verbose,
+        )
+
+    _display_results(history)
+    if output is not None:
+        output.parent.mkdir(parents=True, exist_ok=True)
+        output.write_text(json.dumps(history, indent=2))
+        console.print(f"History written to [bold]{output}[/bold]")
+    return history
+
+
+@app.command("run-node")
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--node-id", type=int, required=True, help="This worker's node id")
+@click.option("--t-start", type=float, required=True, help="Shared round-0 start time")
+@click.option("--run-id", type=str, required=True, help="Run id from the head node")
+@click.option("--host", type=str, default=None, help="This node's bind host")
+def run_node(config_path: Path, node_id, t_start, run_id, host):
+    """Multi-machine ZMQ worker (reference: cli.py:143-208)."""
+    from murmura_tpu.distributed.node_process import run_single_node
+
+    config = load_config(config_path)
+    run_single_node(
+        config, node_id=node_id, t_start=t_start, run_id=run_id, host=host
+    )
+
+
+@app.command("list-components")
+def list_components():
+    """List available components (reference: cli.py:215-259)."""
+    from murmura_tpu.aggregation import AGGREGATORS
+    from murmura_tpu.attacks import ATTACKS
+    from murmura_tpu.topology.generators import TOPOLOGY_TYPES
+
+    table = Table(title="murmura_tpu components")
+    table.add_column("Category", style="cyan")
+    table.add_column("Options")
+    table.add_row("topologies", ", ".join(TOPOLOGY_TYPES))
+    table.add_row("aggregators", ", ".join(sorted(AGGREGATORS)))
+    table.add_row("attacks", ", ".join(sorted(ATTACKS)))
+    table.add_row("backends", "simulation, tpu, distributed")
+    table.add_row(
+        "models",
+        "mlp, leaf.femnist[.tiny/.small/.baseline/.large/.xlarge], "
+        "leaf.celeba, leaf.shakespeare, wearables.{uci_har,pamap2,ppg_dalia}",
+    )
+    table.add_row(
+        "datasets",
+        "synthetic, synthetic_sequences, leaf.{femnist,celeba,shakespeare}, "
+        "wearables.{uci_har,pamap2,ppg_dalia}",
+    )
+    console.print(table)
+
+
+def _display_results(history) -> None:
+    """Rich results table (reference: cli.py:266-304)."""
+    if not history.get("round"):
+        console.print("[yellow]No evaluation rounds recorded[/yellow]")
+        return
+    table = Table(title="Training results")
+    table.add_column("Round", justify="right")
+    table.add_column("Mean acc", justify="right")
+    table.add_column("Std", justify="right")
+    table.add_column("Loss", justify="right")
+    n = len(history["round"])
+    show = sorted(set([0, n // 2, n - 1]))
+    for i in show:
+        table.add_row(
+            str(history["round"][i]),
+            f"{history['mean_accuracy'][i]:.4f}",
+            f"{history['std_accuracy'][i]:.4f}",
+            f"{history['mean_loss'][i]:.4f}",
+        )
+    console.print(table)
+    final = history["mean_accuracy"][-1]
+    console.print(f"Final mean accuracy: [bold green]{final:.4f}[/bold green]")
+
+
+def main():
+    app()
+
+
+if __name__ == "__main__":
+    main()

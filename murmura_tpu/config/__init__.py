@@ -1,0 +1,34 @@
+"""Configuration layer (reference: murmura/config/)."""
+
+from murmura_tpu.config.schema import (
+    AggregationConfig,
+    AttackConfig,
+    Config,
+    DataConfig,
+    DistributedConfig,
+    DMTTConfig,
+    ExperimentConfig,
+    MobilityConfig,
+    ModelConfig,
+    TopologyConfig,
+    TPUConfig,
+    TrainingConfig,
+)
+from murmura_tpu.config.loader import load_config, save_config
+
+__all__ = [
+    "Config",
+    "ExperimentConfig",
+    "TopologyConfig",
+    "AggregationConfig",
+    "AttackConfig",
+    "MobilityConfig",
+    "DMTTConfig",
+    "TrainingConfig",
+    "DataConfig",
+    "ModelConfig",
+    "DistributedConfig",
+    "TPUConfig",
+    "load_config",
+    "save_config",
+]

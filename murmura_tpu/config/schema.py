@@ -1,0 +1,218 @@
+"""Pydantic configuration schema.
+
+Byte-compatible with the reference YAML surface (reference:
+murmura/config/schema.py:7-203) plus the new ``backend: tpu`` enum and an
+optional ``tpu:`` section controlling mesh layout / precision / exchange
+strategy.  ``extra = "forbid"`` everywhere, like the reference
+(murmura/config/schema.py:200-202).
+"""
+
+from typing import Any, Dict, Literal, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class _Strict(BaseModel):
+    model_config = ConfigDict(extra="forbid")
+
+
+class ExperimentConfig(_Strict):
+    """Experiment-level settings (reference: murmura/config/schema.py:54-59)."""
+
+    name: str = Field(description="Experiment name")
+    seed: int = Field(default=42, description="Random seed for reproducibility")
+    rounds: int = Field(default=20, description="Number of training rounds")
+    verbose: bool = Field(default=False, description="Enable verbose logging")
+
+
+class TopologyConfig(_Strict):
+    """Static graph topology (reference: murmura/config/schema.py:62-70)."""
+
+    type: Literal["ring", "fully", "erdos", "k-regular"] = Field(
+        description="Topology type"
+    )
+    num_nodes: int = Field(description="Number of nodes in the network")
+    p: Optional[float] = Field(default=None, description="Edge probability (erdos)")
+    k: Optional[int] = Field(default=None, description="Degree (k-regular)")
+    seed: int = Field(default=12345, description="Topology generation seed")
+
+
+class AggregationConfig(_Strict):
+    """Aggregation rule selection (reference: murmura/config/schema.py:73-81)."""
+
+    algorithm: Literal[
+        "fedavg", "krum", "balance", "sketchguard", "ubar", "evidential_trust"
+    ] = Field(description="Aggregation algorithm")
+    params: Dict[str, Any] = Field(
+        default_factory=dict, description="Algorithm-specific parameters"
+    )
+
+
+class AttackConfig(_Strict):
+    """Byzantine attack scenario (reference: murmura/config/schema.py:84-94)."""
+
+    enabled: bool = Field(default=False, description="Enable Byzantine attacks")
+    type: Optional[Literal["gaussian", "directed_deviation", "topology_liar"]] = Field(
+        default=None, description="Attack type"
+    )
+    percentage: float = Field(default=0.0, description="Fraction of nodes compromised")
+    params: Dict[str, Any] = Field(
+        default_factory=dict, description="Attack-specific parameters"
+    )
+
+
+class MobilityConfig(_Strict):
+    """Random-walk mobility model G^t (reference: murmura/config/schema.py:97-111)."""
+
+    area_size: float = Field(default=100.0, description="2-D arena side length")
+    comm_range: float = Field(
+        default=30.0, description="Edge (i,j) in G^t iff torus-dist < comm_range"
+    )
+    max_speed: float = Field(default=5.0, description="Max displacement per round")
+    seed: int = Field(default=42, description="RNG seed for positions and movement")
+    ensure_connected: bool = Field(
+        default=True, description="Attach isolated nodes to their nearest peer"
+    )
+
+
+class DMTTConfig(_Strict):
+    """DMTT trust-protocol hyperparameters (reference: murmura/config/schema.py:114-139)."""
+
+    budget_B: int = Field(default=5, description="Max collaborators per round")
+    rho: float = Field(default=0.1, description="Link-reliability EMA factor")
+    lambda_forget: float = Field(default=0.9, description="Beta-evidence forgetting")
+    w_d: float = Field(default=1.0, description="Direct confirmation evidence weight")
+    w_c: float = Field(default=0.5, description="Corroboration evidence weight")
+    w_x: float = Field(default=1.0, description="Contradiction evidence weight")
+    tau_U: float = Field(default=0.3, description="Uncertainty tolerance threshold")
+    eta: float = Field(default=5.0, description="Uncertainty penalty scale")
+    w_a: float = Field(default=0.7, description="Accuracy weight in model score")
+    tau_u: float = Field(default=0.5, description="Uncertainty threshold, model score")
+    lambda1: float = Field(default=0.4, description="Model compatibility weight")
+    lambda2: float = Field(default=0.3, description="Topology trust weight")
+    lambda3: float = Field(default=0.2, description="Link reliability weight")
+    lambda4: float = Field(default=0.1, description="Communication cost weight")
+
+
+class TrainingConfig(_Strict):
+    """Local training hyperparameters (reference: murmura/config/schema.py:142-150)."""
+
+    local_epochs: int = Field(default=1, description="Local epochs per round")
+    batch_size: int = Field(default=64, description="Training batch size")
+    lr: float = Field(default=0.01, description="Learning rate")
+    max_samples: Optional[int] = Field(
+        default=None, description="Max samples per client (None for all)"
+    )
+
+
+class DataConfig(_Strict):
+    """Dataset selection (reference: murmura/config/schema.py:153-159)."""
+
+    adapter: str = Field(description="Dataset adapter id (e.g. 'leaf.femnist')")
+    params: Dict[str, Any] = Field(
+        default_factory=dict, description="Dataset-specific parameters"
+    )
+
+
+class ModelConfig(_Strict):
+    """Model selection (reference: murmura/config/schema.py:162-168)."""
+
+    factory: str = Field(description="Model factory identifier")
+    params: Dict[str, Any] = Field(
+        default_factory=dict, description="Model-specific parameters"
+    )
+
+
+class DistributedConfig(_Strict):
+    """ZeroMQ distributed backend (reference: murmura/config/schema.py:7-51)."""
+
+    transport: Literal["ipc", "tcp"] = Field(
+        default="ipc", description="ipc (single machine) or tcp (multi-machine)"
+    )
+    ipc_dir: str = Field(
+        default="/tmp/murmura_tpu", description="Base dir for IPC socket files"
+    )
+    host: str = Field(default="127.0.0.1", description="Coordinator host (tcp)")
+    coordinator_pub_port: int = Field(default=5500, description="Coordinator PUB port")
+    coordinator_pull_port: int = Field(default=5501, description="Coordinator PULL port")
+    base_port: int = Field(
+        default=5550, description="Node i binds its PULL socket on base_port + i"
+    )
+    node_hosts: Optional[Dict[int, str]] = Field(
+        default=None, description="Per-node host overrides for tcp: {node_id: host}"
+    )
+    round_duration_s: float = Field(
+        default=60.0, description="Wall-clock budget per round in seconds"
+    )
+    startup_grace_s: float = Field(
+        default=5.0, description="Seconds between launch and the first round start"
+    )
+
+
+class TPUConfig(_Strict):
+    """TPU backend settings — new in murmura_tpu (no reference counterpart).
+
+    Controls how the ``nodes`` axis of the stacked network state is laid out
+    over a :class:`jax.sharding.Mesh` and how the per-round neighbor exchange
+    is realized as XLA collectives.
+    """
+
+    num_devices: Optional[int] = Field(
+        default=None,
+        description="Devices in the mesh (None = all available devices)",
+    )
+    exchange: Literal["allgather", "ppermute"] = Field(
+        default="allgather",
+        description=(
+            "Neighbor exchange strategy: allgather (every node sees [N,P]; "
+            "O(N) memory, right for dense graphs) or ppermute (ring shifts, "
+            "O(degree); right for ring/k-regular at large N)"
+        ),
+    )
+    param_dtype: Literal["float32", "bfloat16"] = Field(
+        default="float32", description="Model parameter dtype"
+    )
+    compute_dtype: Literal["float32", "bfloat16"] = Field(
+        default="bfloat16", description="Matmul/conv compute dtype (MXU-friendly)"
+    )
+    donate_state: bool = Field(
+        default=True, description="Donate round-step input buffers to XLA"
+    )
+    profile_dir: Optional[str] = Field(
+        default=None, description="If set, write a jax.profiler trace here"
+    )
+
+
+class Config(_Strict):
+    """Top-level config object (reference: murmura/config/schema.py:171-198)."""
+
+    experiment: ExperimentConfig
+    topology: TopologyConfig
+    aggregation: AggregationConfig
+    attack: AttackConfig = Field(default_factory=AttackConfig)
+    training: TrainingConfig
+    data: DataConfig
+    model: ModelConfig
+    backend: Literal["simulation", "distributed", "tpu"] = Field(
+        default="simulation",
+        description=(
+            "Execution backend: simulation (single-device vmap), distributed "
+            "(ZMQ multi-process), or tpu (node axis sharded over a device mesh)"
+        ),
+    )
+    distributed: DistributedConfig = Field(
+        default_factory=DistributedConfig,
+        description="ZMQ backend settings (used when backend=distributed)",
+    )
+    tpu: TPUConfig = Field(
+        default_factory=TPUConfig,
+        description="TPU backend settings (used when backend=tpu)",
+    )
+    mobility: Optional[MobilityConfig] = Field(
+        default=None,
+        description="Mobility model; if set, topology varies per round via G^t",
+    )
+    dmtt: Optional[DMTTConfig] = Field(
+        default=None,
+        description="DMTT protocol settings; requires mobility to also be set",
+    )

@@ -1,0 +1,7 @@
+"""Core runtime: the jitted round program and Network orchestrator
+(reference: murmura/core/)."""
+
+from murmura_tpu.core.network import Network
+from murmura_tpu.core.rounds import RoundProgram, build_round_program
+
+__all__ = ["Network", "RoundProgram", "build_round_program"]

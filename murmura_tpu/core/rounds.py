@@ -1,0 +1,293 @@
+"""The FL round as one jitted program.
+
+The reference executes a round as Python orchestration — per-node
+``local_train`` loops (murmura/core/node.py:59-109), a state snapshot, attack
+application, per-node aggregation calls, then per-node evaluation
+(murmura/core/network.py:80-199).  Here the whole round body is one traced
+function over stacked [N, ...] pytrees:
+
+    round_step(params, agg_state, key, adj, compromised, round_idx, data)
+        -> (params', agg_state', metrics)
+
+- local training is a ``lax.scan`` over the per-epoch batch schedule with
+  per-node effective batch sizes / step counts as masks (reproducing the
+  reference's ragged DataLoaders, network.py:278-287);
+- compromised nodes skip training via an update mask instead of a Python
+  ``if`` (network.py:99-101);
+- the attack transforms the *broadcast* tensor only (network.py:108-119);
+- aggregation is an adjacency-masked rule over the gathered [N, P] tensor;
+- evaluation is a vmapped masked sweep including evidential uncertainty
+  (node.py:111-196).
+
+Under ``backend: simulation`` this runs vmapped on one device; under
+``backend: tpu`` the same function is jitted with the node axis sharded over
+a mesh so the gather rides ICI (see parallel/mesh.py).
+"""
+
+import functools
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from murmura_tpu.aggregation.base import AggContext, AggregatorDef
+from murmura_tpu.attacks.base import Attack
+from murmura_tpu.data.base import FederatedArrays
+from murmura_tpu.models.core import Model
+from murmura_tpu.ops.flatten import make_flatteners
+from murmura_tpu.ops.losses import (
+    evidential_loss,
+    masked_cross_entropy,
+    uncertainty_metrics,
+)
+
+
+@dataclass(frozen=True)
+class RoundProgram:
+    """A compiled round step plus the pieces needed to drive it."""
+
+    step: Callable  # (params, agg_state, key, adj, compromised, round_idx, data)
+    init_params: Any  # stacked [N, ...] pytree
+    init_agg_state: Dict[str, np.ndarray]
+    data_arrays: Dict[str, np.ndarray]
+    num_nodes: int
+    model_dim: int
+    evidential: bool
+
+
+def _broadcast_to_leaf(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
+    return mask.reshape(mask.shape + (1,) * (leaf.ndim - 1))
+
+
+def build_round_program(
+    model: Model,
+    agg: AggregatorDef,
+    data: FederatedArrays,
+    *,
+    local_epochs: int = 1,
+    batch_size: int = 64,
+    lr: float = 0.01,
+    total_rounds: int = 20,
+    attack: Optional[Attack] = None,
+    seed: int = 42,
+    probe_size: Optional[int] = None,
+    annealing_rounds: Optional[int] = None,
+    lambda_weight: float = 0.1,
+    eval_chunk: int = 1024,
+) -> RoundProgram:
+    """Trace-ready round step for a network of ``data.num_nodes`` nodes.
+
+    Args:
+        probe_size: samples per node handed to probe-based aggregators
+            (UBAR's one batch — ubar.py:169; evidential trust's
+            max_eval_samples — evidential_trust.py:62-63).
+        annealing_rounds: evidential-loss KL annealing horizon (reference
+            wiring: rounds // 2, factories.py:114).
+    """
+    n = data.num_nodes
+    num_classes = data.num_classes or model.num_classes
+    evidential = model.evidential
+
+    # ---- static per-node batch schedule (network.py:278-287) -------------
+    eff_batch = data.effective_batch(batch_size)  # [N]
+    steps = data.steps_per_epoch(batch_size)  # [N]
+    max_steps = int(steps.max())
+    global_batch = int(eff_batch.max())
+
+    if annealing_rounds is None:
+        annealing_rounds = max(1, total_rounds // 2)
+
+    # ---- initial stacked params ------------------------------------------
+    init_keys = jax.random.split(jax.random.PRNGKey(seed), n)
+    init_params = jax.vmap(model.init)(init_keys)
+    template = jax.tree_util.tree_map(lambda l: l[0], init_params)
+    ravel, unravel, model_dim = make_flatteners(template)
+
+    # ---- probe batches for loss/trust-probe rules ------------------------
+    p_size = int(min(data.max_samples, probe_size or global_batch))
+    probe_x = data.x[:, :p_size]
+    probe_y = data.y[:, :p_size]
+    probe_mask = data.mask[:, :p_size]
+
+    eval_x, eval_y, eval_mask = data.eval_arrays
+
+    data_arrays = {
+        "x": data.x,
+        "y": data.y,
+        "mask": data.mask,
+        "num_samples": data.num_samples.astype(np.int32),
+        "eff_batch": eff_batch,
+        "steps": steps,
+        "probe_x": probe_x,
+        "probe_y": probe_y,
+        "probe_mask": probe_mask,
+        "eval_x": eval_x,
+        "eval_y": eval_y,
+        "eval_mask": eval_mask,
+    }
+
+    # ---- per-node loss ----------------------------------------------------
+    def node_loss(params_i, xb, yb, mb, key, round_idx):
+        outputs = model.apply(params_i, xb, key, True)
+        if evidential:
+            lambda_t = (
+                jnp.minimum(1.0, round_idx / max(1, annealing_rounds)) * lambda_weight
+            )
+            return evidential_loss(outputs, yb, mb, num_classes, lambda_t)
+        loss, _ = masked_cross_entropy(outputs, yb, mb)
+        return loss
+
+    grad_fn = jax.grad(node_loss)
+
+    def local_training(params, d, honest, key, round_idx):
+        """local_epochs x masked-batch SGD (reference: node.py:59-109)."""
+
+        def epoch_body(params, epoch_key):
+            perm_key, step_key = jax.random.split(epoch_key)
+            # Shuffle valid samples to the front: invalid slots sort last.
+            u = jax.random.uniform(perm_key, d["mask"].shape) + (1.0 - d["mask"]) * 10.0
+            perm = jnp.argsort(u, axis=1)  # [N, S]
+
+            def step_body(params, t):
+                j = jnp.arange(global_batch)
+                pos = t * d["eff_batch"][:, None] + j[None, :]
+                pos = pos % jnp.maximum(d["num_samples"], 1)[:, None]
+                idx = jnp.take_along_axis(perm, pos, axis=1)  # [N, B]
+                xb = jax.vmap(lambda xs, ii: xs[ii])(d["x"], idx)
+                yb = jax.vmap(lambda ys, ii: ys[ii])(d["y"], idx)
+                batch_mask = (j[None, :] < d["eff_batch"][:, None]).astype(jnp.float32)
+
+                node_keys = jax.random.split(jax.random.fold_in(step_key, t), n)
+                grads = jax.vmap(grad_fn, in_axes=(0, 0, 0, 0, 0, None))(
+                    params, xb, yb, batch_mask, node_keys, round_idx
+                )
+                update = honest * (t < d["steps"]).astype(jnp.float32)  # [N]
+                new_params = jax.tree_util.tree_map(
+                    lambda p, g: p - lr * _broadcast_to_leaf(update, p) * g,
+                    params,
+                    grads,
+                )
+                return new_params, None
+
+            params, _ = jax.lax.scan(step_body, params, jnp.arange(max_steps))
+            return params, None
+
+        epoch_keys = jax.random.split(key, local_epochs)
+        params, _ = jax.lax.scan(epoch_body, params, epoch_keys)
+        return params
+
+    # ---- evaluation (node.py:111-196) ------------------------------------
+    def evaluate(params, x, y, mask):
+        s = x.shape[1]
+        chunk = min(eval_chunk, s)
+        n_chunks = -(-s // chunk)
+        pad = n_chunks * chunk - s
+        if pad:
+            x = jnp.pad(x, [(0, 0), (0, pad)] + [(0, 0)] * (x.ndim - 2))
+            y = jnp.pad(y, [(0, 0), (0, pad)])
+            mask = jnp.pad(mask, [(0, 0), (0, pad)])
+
+        def eval_node(params_i, x_i, y_i, m_i):
+            def chunk_body(carry, sl):
+                xc = jax.lax.dynamic_slice_in_dim(x_i, sl * chunk, chunk, 0)
+                yc = jax.lax.dynamic_slice_in_dim(y_i, sl * chunk, chunk, 0)
+                mc = jax.lax.dynamic_slice_in_dim(m_i, sl * chunk, chunk, 0)
+                outputs = model.apply(params_i, xc, None, False)
+                cnt = mc.sum()
+                if evidential:
+                    unc = uncertainty_metrics(outputs)
+                    probs = unc["probs"]
+                    nll = -jnp.log(
+                        jnp.take_along_axis(probs, yc[:, None], axis=-1)[:, 0] + 1e-10
+                    )
+                    row = {
+                        "loss": (nll * mc).sum(),
+                        "correct": (
+                            (jnp.argmax(outputs, -1) == yc).astype(jnp.float32) * mc
+                        ).sum(),
+                        "vacuity": (unc["vacuity"] * mc).sum(),
+                        "entropy": (unc["entropy"] * mc).sum(),
+                        "strength": (unc["strength"] * mc).sum(),
+                        "count": cnt,
+                    }
+                else:
+                    logp = jax.nn.log_softmax(outputs, -1)
+                    nll = -jnp.take_along_axis(logp, yc[:, None], axis=-1)[:, 0]
+                    row = {
+                        "loss": (nll * mc).sum(),
+                        "correct": (
+                            (jnp.argmax(outputs, -1) == yc).astype(jnp.float32) * mc
+                        ).sum(),
+                        "count": cnt,
+                    }
+                return carry, row
+
+            _, rows = jax.lax.scan(chunk_body, 0, jnp.arange(n_chunks))
+            total = jnp.maximum(rows["count"].sum(), 1.0)
+            out = {k: v.sum() / total for k, v in rows.items() if k != "count"}
+            out["accuracy"] = out.pop("correct")
+            return out
+
+        return jax.vmap(eval_node)(params, x, y, mask)
+
+    # ---- the round --------------------------------------------------------
+    ctx = AggContext(
+        apply_fn=model.apply,
+        unravel=unravel,
+        evidential=evidential,
+        num_classes=num_classes,
+        total_rounds=total_rounds,
+    )
+
+    attack_apply = attack.apply if attack is not None else None
+
+    def round_step(params, agg_state, key, adj, compromised, round_idx, d):
+        train_key, attack_key = jax.random.split(key)
+        honest = 1.0 - compromised
+
+        # 1. local training (compromised nodes frozen — network.py:99-101)
+        params = local_training(params, d, honest, train_key, round_idx)
+
+        # 2. snapshot + attack on outgoing states (network.py:105-119)
+        own_flat = jax.vmap(ravel)(params)
+        if attack_apply is not None:
+            bcast = attack_apply(own_flat, compromised, attack_key, round_idx)
+        else:
+            bcast = own_flat
+
+        # 3. adjacency-masked aggregation (network.py:121-139)
+        step_ctx = AggContext(
+            apply_fn=ctx.apply_fn,
+            unravel=ctx.unravel,
+            probe_x=d["probe_x"],
+            probe_y=d["probe_y"],
+            probe_mask=d["probe_mask"],
+            evidential=ctx.evidential,
+            num_classes=ctx.num_classes,
+            total_rounds=ctx.total_rounds,
+        )
+        new_flat, agg_state, agg_stats = agg.aggregate(
+            own_flat, bcast, adj, round_idx, agg_state, step_ctx
+        )
+        params = jax.vmap(unravel)(new_flat)
+
+        # 4. evaluation (network.py:141-199)
+        metrics = evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
+        metrics.update({f"agg_{k}": v for k, v in agg_stats.items()})
+        return params, agg_state, metrics
+
+    init_agg_state = {
+        k: np.asarray(v) for k, v in agg.init_state(n).items()
+    }
+
+    return RoundProgram(
+        step=round_step,
+        init_params=init_params,
+        init_agg_state=init_agg_state,
+        data_arrays=data_arrays,
+        num_nodes=n,
+        model_dim=model_dim,
+        evidential=evidential,
+    )

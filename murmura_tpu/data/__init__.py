@@ -1,0 +1,25 @@
+"""Federated data layer (reference: murmura/data/).
+
+TPU-first design: rather than the reference's per-node ragged
+``torch.utils.data.Subset`` + ``DataLoader`` objects (murmura/data/adapters.py,
+murmura/core/network.py:275-294), every node's shard is padded into one stacked
+array family ``x[N, S, ...], y[N, S], mask[N, S]`` so the whole network's data
+lives device-resident and the per-round batch loop is a static-shape gather.
+"""
+
+from murmura_tpu.data.partitioners import (
+    combine_partitions_with_dirichlet,
+    dirichlet_partition,
+    iid_partition,
+    natural_partition,
+)
+from murmura_tpu.data.base import FederatedArrays, stack_partitions
+
+__all__ = [
+    "dirichlet_partition",
+    "iid_partition",
+    "natural_partition",
+    "combine_partitions_with_dirichlet",
+    "FederatedArrays",
+    "stack_partitions",
+]

@@ -1,0 +1,144 @@
+"""LEAF benchmark datasets (femnist, celeba, shakespeare) from the LEAF JSON
+layout (reference: murmura/examples/leaf/datasets.py:23-199, 300-377).
+
+Loads per-split JSON shards with user->samples maps, applies the reference's
+natural user partitioning (seeded user shuffle, round-robin users -> nodes,
+paired train/test partitions — datasets.py:300-377).  When no ``data_path``
+is given (or ``synthetic: true``), emits shape-identical synthetic data so
+every config remains runnable in a zero-egress environment.
+"""
+
+import json
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from murmura_tpu.data.base import FederatedArrays, stack_partitions
+from murmura_tpu.data.synthetic import make_synthetic, make_synthetic_sequences
+
+FEMNIST_CLASSES = 62
+SHAKESPEARE_VOCAB = 81
+
+
+def _load_leaf_json_dir(split_dir: Path) -> Tuple[List[str], Dict[str, Dict]]:
+    """Merge all JSON shards in a LEAF split dir into (users, user_data)
+    (reference: datasets.py:23-93)."""
+    users: List[str] = []
+    user_data: Dict[str, Dict] = {}
+    for shard in sorted(split_dir.glob("*.json")):
+        with open(shard) as f:
+            blob = json.load(f)
+        users.extend(blob.get("users", []))
+        user_data.update(blob.get("user_data", {}))
+    return users, user_data
+
+
+def _round_robin_users(
+    users: List[str], num_nodes: int, seed: int
+) -> List[List[str]]:
+    """Seeded user shuffle then round-robin users -> nodes
+    (reference: datasets.py:300-340)."""
+    rng = np.random.default_rng(seed)
+    order = list(users)
+    rng.shuffle(order)
+    groups: List[List[str]] = [[] for _ in range(num_nodes)]
+    for i, u in enumerate(order):
+        groups[i % num_nodes].append(u)
+    return groups
+
+
+def _femnist_from_json(
+    data_path: Path, num_nodes: int, seed: int, max_samples: Optional[int]
+) -> FederatedArrays:
+    train_users, train_data = _load_leaf_json_dir(data_path / "train")
+    groups = _round_robin_users(train_users, num_nodes, seed)
+
+    xs, ys = [], []
+    offsets: Dict[str, Tuple[int, int]] = {}
+    cursor = 0
+    for u in train_users:
+        ux = np.asarray(train_data[u]["x"], dtype=np.float32).reshape(-1, 28, 28, 1)
+        uy = np.asarray(train_data[u]["y"], dtype=np.int32)
+        xs.append(ux)
+        ys.append(uy)
+        offsets[u] = (cursor, cursor + len(uy))
+        cursor += len(uy)
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+
+    partitions = [
+        [i for u in group for i in range(*offsets[u])] for group in groups
+    ]
+    return stack_partitions(
+        x, y, partitions, max_samples=max_samples, num_classes=FEMNIST_CLASSES
+    )
+
+
+def load_leaf_federated(
+    dataset: str,
+    params: Dict[str, Any],
+    num_nodes: int,
+    seed: int = 42,
+    max_samples: Optional[int] = None,
+) -> FederatedArrays:
+    """Load a LEAF dataset (reference: murmura/examples/leaf/adapter.py:19-61)."""
+    params = dict(params or {})
+    data_path = params.get("data_path")
+    use_synthetic = bool(params.get("synthetic", data_path is None))
+
+    if not use_synthetic:
+        root = Path(data_path)
+        if not root.exists():
+            raise FileNotFoundError(
+                f"LEAF data path not found: {root}. Pass data.params.synthetic: true "
+                "for shape-identical synthetic data."
+            )
+        if dataset == "femnist":
+            return _femnist_from_json(root, num_nodes, seed, max_samples)
+        raise NotImplementedError(
+            f"On-disk loading for leaf.{dataset} not implemented yet; "
+            "use synthetic: true"
+        )
+
+    # ---- synthetic, shape-identical fallbacks ----------------------------
+    n_total = int(params.get("num_samples", max(2000, 200 * num_nodes)))
+    if dataset == "femnist":
+        x, y = make_synthetic(
+            num_samples=n_total,
+            input_shape=(28, 28, 1),
+            num_classes=FEMNIST_CLASSES,
+            cluster_std=float(params.get("cluster_std", 2.0)),
+            seed=seed,
+        )
+        num_classes = FEMNIST_CLASSES
+    elif dataset == "celeba":
+        x, y = make_synthetic(
+            num_samples=n_total,
+            input_shape=(84, 84, 3),
+            num_classes=2,
+            seed=seed,
+        )
+        num_classes = 2
+    elif dataset == "shakespeare":
+        x, y = make_synthetic_sequences(
+            num_samples=n_total,
+            seq_len=int(params.get("seq_len", 80)),
+            vocab_size=SHAKESPEARE_VOCAB,
+            seed=seed,
+        )
+        num_classes = SHAKESPEARE_VOCAB
+    else:
+        raise ValueError(f"Unknown LEAF dataset: {dataset}")
+
+    from murmura_tpu.data.partitioners import dirichlet_partition, iid_partition
+
+    if params.get("partition_method", "dirichlet") == "dirichlet" and num_classes > 2:
+        parts = dirichlet_partition(
+            y, num_nodes, alpha=float(params.get("alpha", 0.5)), seed=seed
+        )
+    else:
+        parts = iid_partition(len(y), num_nodes, seed=seed)
+    return stack_partitions(
+        x, y, parts, max_samples=max_samples, num_classes=num_classes
+    )

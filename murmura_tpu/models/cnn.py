@@ -1,0 +1,141 @@
+"""FEMNIST CNN family and CelebA CNN.
+
+Architectural parity with the reference LEAF models
+(murmura/examples/leaf/datasets.py:204-297, murmura/examples/leaf/models.py:12-192):
+- femnist baseline: conv5x5x32 -> pool -> conv5x5x64 -> pool -> fc2048 -> fc62
+  (~6.5M params);
+- scaling variants tiny (8/16/fc256), small (16/32/fc512), large (64/128/fc4096),
+  xlarge (3x3 convs 64/128/256 + fc4096 + fc2048).
+
+All convs are NHWC with SAME padding; 28x28 grayscale in, two 2x2 max-pools
+down to 7x7 before the dense stack — shapes that tile cleanly onto the MXU.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from murmura_tpu.models.core import (
+    Model,
+    conv2d,
+    conv_init,
+    dense,
+    dense_init,
+    max_pool,
+)
+
+FEMNIST_VARIANTS = {
+    # variant: (conv_channels, kernel, fc_dims)
+    "tiny": ((8, 16), 5, (256,)),
+    "small": ((16, 32), 5, (512,)),
+    "baseline": ((32, 64), 5, (2048,)),
+    "large": ((64, 128), 5, (4096,)),
+    "xlarge": ((64, 128, 256), 3, (4096, 2048)),
+}
+
+
+def make_femnist_cnn(
+    num_classes: int = 62,
+    variant: str = "baseline",
+    image_size: int = 28,
+    channels_in: int = 1,
+    name: str = None,
+) -> Model:
+    """Build a FEMNIST CNN ``Model`` for 28x28x1 inputs."""
+    if variant not in FEMNIST_VARIANTS:
+        raise ValueError(
+            f"Unknown FEMNIST variant '{variant}' (choose from {list(FEMNIST_VARIANTS)})"
+        )
+    conv_channels, kernel, fc_dims = FEMNIST_VARIANTS[variant]
+    # xlarge applies conv1,conv2 then pool, conv3 then pool (reference:
+    # examples/leaf/models.py:159-169); others pool after every conv.
+    final_hw = image_size // 4
+    flat_dim = final_hw * final_hw * conv_channels[-1]
+    dense_dims = [flat_dim] + list(fc_dims) + [num_classes]
+
+    def init(key: jax.Array):
+        n_conv = len(conv_channels)
+        n_fc = len(dense_dims) - 1
+        keys = jax.random.split(key, n_conv + n_fc)
+        params = {"convs": [], "fcs": []}
+        c_prev = channels_in
+        for i, c in enumerate(conv_channels):
+            params["convs"].append(conv_init(keys[i], kernel, kernel, c_prev, c))
+            c_prev = c
+        for j in range(n_fc):
+            params["fcs"].append(
+                dense_init(keys[n_conv + j], dense_dims[j], dense_dims[j + 1])
+            )
+        return params
+
+    def apply(params, x, key=None, train=False):
+        if x.ndim == 3:
+            x = x[..., None]
+        n_conv = len(params["convs"])
+        if n_conv == 2:
+            for conv_p in params["convs"]:
+                x = jax.nn.relu(conv2d(conv_p, x))
+                x = max_pool(x)
+        else:
+            x = jax.nn.relu(conv2d(params["convs"][0], x))
+            x = jax.nn.relu(conv2d(params["convs"][1], x))
+            x = max_pool(x)
+            x = jax.nn.relu(conv2d(params["convs"][2], x))
+            x = max_pool(x)
+        x = x.reshape((x.shape[0], -1))
+        for fc in params["fcs"][:-1]:
+            x = jax.nn.relu(dense(fc, x))
+        return dense(params["fcs"][-1], x)
+
+    return Model(
+        name=name or f"leaf.femnist.{variant}",
+        init=init,
+        apply=apply,
+        evidential=False,
+        input_shape=(image_size, image_size, channels_in),
+        num_classes=num_classes,
+        meta={"variant": variant},
+    )
+
+
+def make_celeba_cnn(
+    num_classes: int = 2,
+    image_size: int = 84,
+    channels: Sequence[int] = (32, 64, 128),
+    fc_dim: int = 256,
+    name: str = "leaf.celeba",
+) -> Model:
+    """LeNet-style CelebA CNN for 84x84 RGB
+    (reference: murmura/examples/leaf/datasets.py:235-297)."""
+    n_conv = len(channels)
+    final_hw = image_size // (2**n_conv)
+    flat_dim = final_hw * final_hw * channels[-1]
+
+    def init(key: jax.Array):
+        keys = jax.random.split(key, n_conv + 2)
+        params = {"convs": [], "fcs": []}
+        c_prev = 3
+        for i, c in enumerate(channels):
+            params["convs"].append(conv_init(keys[i], 3, 3, c_prev, c))
+            c_prev = c
+        params["fcs"].append(dense_init(keys[n_conv], flat_dim, fc_dim))
+        params["fcs"].append(dense_init(keys[n_conv + 1], fc_dim, num_classes))
+        return params
+
+    def apply(params, x, key=None, train=False):
+        for conv_p in params["convs"]:
+            x = jax.nn.relu(conv2d(conv_p, x))
+            x = max_pool(x)
+        x = x.reshape((x.shape[0], -1))
+        x = jax.nn.relu(dense(params["fcs"][0], x))
+        return dense(params["fcs"][1], x)
+
+    return Model(
+        name=name,
+        init=init,
+        apply=apply,
+        evidential=False,
+        input_shape=(image_size, image_size, 3),
+        num_classes=num_classes,
+    )

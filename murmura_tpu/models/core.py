@@ -1,0 +1,138 @@
+"""Functional model abstraction and layer primitives.
+
+Models are (init, apply) pairs over plain dict pytrees — no module classes,
+no mutable state. This is what makes the framework's core trick cheap:
+stacking N nodes' parameters along a leading axis and vmap/shard_map-ing
+``apply`` over it (the reference instead deep-copies nn.Modules and calls
+``load_state_dict`` per neighbor per round — murmura/aggregation/
+evidential_trust.py:236-260, a cost this design eliminates).
+
+Conventions:
+- ``init(key) -> params`` (nested dict of float32 arrays);
+- ``apply(params, x, key, train) -> outputs`` where ``train`` is a Python
+  bool (static under trace) and ``key`` drives dropout when training;
+- images are NHWC; convs/matmuls stay large and batched for the MXU.
+
+Normalization: models use LayerNorm instead of the reference's BatchNorm1d
+(murmura/examples/wearables/models.py:208). BatchNorm's integer
+``num_batches_tracked`` buffer forces the reference to special-case
+non-float state in every aggregator (aggregation/base.py:100-113); LayerNorm
+keeps the whole state float, aggregatable, and jit-friendly.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Params = Any
+
+
+@dataclass(frozen=True)
+class Model:
+    """A functional model: pure init/apply plus metadata.
+
+    Attributes:
+        name: registry id.
+        init: key -> params pytree.
+        apply: (params, x, key, train) -> [B, K] logits, or Dirichlet alphas
+            when ``evidential`` is True.
+        evidential: whether outputs are Dirichlet concentration parameters.
+        input_shape: per-sample input shape (no batch dim).
+        num_classes: output arity.
+    """
+
+    name: str
+    init: Callable[[jax.Array], Params]
+    apply: Callable[[Params, jnp.ndarray, Optional[jax.Array], bool], jnp.ndarray]
+    evidential: bool = False
+    input_shape: Tuple[int, ...] = ()
+    num_classes: int = 0
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+# ---------------------------------------------------------------------------
+# Layer primitives
+# ---------------------------------------------------------------------------
+
+
+def dense_init(key: jax.Array, in_dim: int, out_dim: int) -> Params:
+    """He-uniform linear layer init (matches torch.nn.Linear's default
+    kaiming-uniform fan_in scaling)."""
+    kw, kb = jax.random.split(key)
+    bound = 1.0 / jnp.sqrt(in_dim)
+    return {
+        "w": jax.random.uniform(kw, (in_dim, out_dim), jnp.float32, -bound, bound),
+        "b": jax.random.uniform(kb, (out_dim,), jnp.float32, -bound, bound),
+    }
+
+
+def dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    return x @ p["w"] + p["b"]
+
+
+def conv_init(key: jax.Array, kh: int, kw: int, c_in: int, c_out: int) -> Params:
+    """5x5/3x3 conv init, kaiming-uniform over fan_in."""
+    k1, k2 = jax.random.split(key)
+    fan_in = kh * kw * c_in
+    bound = 1.0 / jnp.sqrt(fan_in)
+    return {
+        "w": jax.random.uniform(
+            k1, (kh, kw, c_in, c_out), jnp.float32, -bound, bound
+        ),
+        "b": jax.random.uniform(k2, (c_out,), jnp.float32, -bound, bound),
+    }
+
+
+def conv2d(p: Params, x: jnp.ndarray, padding: str = "SAME") -> jnp.ndarray:
+    """NHWC conv with HWIO kernel."""
+    y = jax.lax.conv_general_dilated(
+        x,
+        p["w"],
+        window_strides=(1, 1),
+        padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return y + p["b"]
+
+
+def max_pool(x: jnp.ndarray, window: int = 2, stride: int = 2) -> jnp.ndarray:
+    return jax.lax.reduce_window(
+        x,
+        -jnp.inf,
+        jax.lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding="VALID",
+    )
+
+
+def layernorm_init(dim: int) -> Params:
+    return {"scale": jnp.ones((dim,)), "bias": jnp.zeros((dim,))}
+
+
+def layernorm(p: Params, x: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * p["scale"] + p["bias"]
+
+
+def dropout(
+    key: Optional[jax.Array], x: jnp.ndarray, rate: float, train: bool
+) -> jnp.ndarray:
+    if not train or rate <= 0.0 or key is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
+def evidential_head(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    """Dense -> softplus evidence -> alpha = evidence + 1
+    (reference: murmura/examples/wearables/models.py:18-46)."""
+    return jax.nn.softplus(dense(p, x)) + 1.0
+
+
+def split_keys(key: jax.Array, n: int) -> Sequence[jax.Array]:
+    return jax.random.split(key, n)

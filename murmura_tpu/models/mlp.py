@@ -1,0 +1,96 @@
+"""MLP classifiers: plain softmax and evidential variants.
+
+The evidential MLP is the wearables model family (reference:
+murmura/examples/wearables/models.py:187-347): Linear -> norm -> ReLU ->
+Dropout feature stacks with an evidential head producing Dirichlet alphas.
+LayerNorm replaces the reference's BatchNorm1d (see models/core.py docstring).
+"""
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from murmura_tpu.models.core import (
+    Model,
+    dense,
+    dense_init,
+    dropout,
+    evidential_head,
+    layernorm,
+    layernorm_init,
+)
+
+
+def make_mlp(
+    input_dim: int,
+    hidden_dims: Sequence[int] = (64, 32),
+    num_classes: int = 10,
+    dropout_rate: float = 0.0,
+    evidential: bool = False,
+    name: str = "mlp",
+) -> Model:
+    """Build an MLP ``Model``.
+
+    Args:
+        input_dim: flattened input feature size.
+        hidden_dims: widths of hidden layers.
+        num_classes: output classes.
+        dropout_rate: dropout after each hidden block.
+        evidential: if True, output Dirichlet alphas via softplus head.
+    """
+    dims = [int(input_dim)] + [int(h) for h in hidden_dims]
+
+    def init(key: jax.Array):
+        keys = jax.random.split(key, len(dims))
+        params = {"layers": [], "head": None}
+        for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+            params["layers"].append(
+                {"fc": dense_init(keys[i], d_in, d_out), "ln": layernorm_init(d_out)}
+            )
+        params["head"] = dense_init(keys[-1], dims[-1], num_classes)
+        return params
+
+    def apply(params, x, key=None, train=False):
+        x = x.reshape((x.shape[0], -1))
+        n_layers = len(dims) - 1
+        drop_keys = (
+            jax.random.split(key, n_layers) if (train and key is not None) else [None] * n_layers
+        )
+        for i, layer in enumerate(params["layers"]):
+            x = dense(layer["fc"], x)
+            x = layernorm(layer["ln"], x)
+            x = jax.nn.relu(x)
+            x = dropout(drop_keys[i], x, dropout_rate, train)
+        if evidential:
+            return evidential_head(params["head"], x)
+        return dense(params["head"], x)
+
+    return Model(
+        name=name,
+        init=init,
+        apply=apply,
+        evidential=evidential,
+        input_shape=(input_dim,),
+        num_classes=num_classes,
+        meta={"hidden_dims": tuple(hidden_dims), "dropout": dropout_rate},
+    )
+
+
+def make_wearable_mlp(
+    input_dim: int = 561,
+    hidden_dims: Tuple[int, ...] = (256, 128),
+    num_classes: int = 6,
+    dropout: float = 0.3,
+    name: str = "wearables.mlp",
+) -> Model:
+    """Evidential wearable classifier (reference: wearables/models.py:187-229
+    — UCI HAR default: 561 -> 256 -> 128 -> Evidential(6))."""
+    return make_mlp(
+        input_dim=input_dim,
+        hidden_dims=hidden_dims,
+        num_classes=num_classes,
+        dropout_rate=dropout,
+        evidential=True,
+        name=name,
+    )

@@ -1,0 +1,84 @@
+"""Model factory registry: config factory strings -> Model builders.
+
+Mirrors the reference's string-addressed factories
+(murmura/utils/factories.py:45-61: ``examples.leaf.*`` / ``examples.wearables.*``
+prefixes) plus native ids for the new framework's own models.
+"""
+
+from typing import Any, Dict
+
+from murmura_tpu.models.cnn import FEMNIST_VARIANTS, make_celeba_cnn, make_femnist_cnn
+from murmura_tpu.models.core import Model
+from murmura_tpu.models.lstm import make_char_lstm
+from murmura_tpu.models.mlp import make_mlp, make_wearable_mlp
+
+# Wearable dataset default dims (reference: murmura/examples/wearables/models.py:355-481)
+_WEARABLE_DEFAULTS = {
+    "uci_har": {"input_dim": 561, "hidden_dims": (256, 128), "num_classes": 6},
+    "pamap2": {"input_dim": 243, "hidden_dims": (256, 128), "num_classes": 12},
+    "ppg_dalia": {"input_dim": 16, "hidden_dims": (128, 64), "num_classes": 7},
+}
+
+
+def build_model(factory: str, params: Dict[str, Any]) -> Model:
+    """Resolve a config ``model.factory`` string to a Model.
+
+    Accepted ids:
+    - ``mlp`` — generic softmax MLP (params: input_dim, hidden_dims,
+      num_classes, dropout, evidential).
+    - ``examples.leaf.LEAFFEMNISTModel`` / ``leaf.femnist[.variant]`` —
+      FEMNIST CNN family (variant in tiny/small/baseline/large/xlarge).
+    - ``examples.leaf.LEAFCelebAModel`` / ``leaf.celeba`` — CelebA CNN.
+    - ``leaf.shakespeare`` — char-LSTM.
+    - ``examples.wearables.<uci_har|pamap2|ppg_dalia>`` /
+      ``wearables.<...>`` — evidential wearable MLPs.
+    """
+    params = dict(params or {})
+    f = factory.strip()
+
+    if f == "mlp":
+        evidential = bool(params.pop("evidential", False))
+        return make_mlp(
+            input_dim=int(params.pop("input_dim", 32)),
+            hidden_dims=tuple(params.pop("hidden_dims", (64, 32))),
+            num_classes=int(params.pop("num_classes", 10)),
+            dropout_rate=float(params.pop("dropout", 0.0)),
+            evidential=evidential,
+        )
+
+    lowered = f.lower()
+    if "femnist" in lowered:
+        variant = params.pop("variant", None)
+        if variant is None:
+            tail = lowered.rsplit(".", 1)[-1]
+            variant = tail if tail in FEMNIST_VARIANTS else "baseline"
+        return make_femnist_cnn(
+            num_classes=int(params.pop("num_classes", 62)), variant=variant
+        )
+
+    if "celeba" in lowered:
+        return make_celeba_cnn(num_classes=int(params.pop("num_classes", 2)))
+
+    if "shakespeare" in lowered:
+        return make_char_lstm(
+            vocab_size=int(params.pop("vocab_size", 81)),
+            embed_dim=int(params.pop("embed_dim", 8)),
+            hidden=int(params.pop("hidden", 256)),
+            num_layers=int(params.pop("num_layers", 2)),
+            seq_len=int(params.pop("seq_len", 80)),
+        )
+
+    for prefix in ("examples.wearables.", "wearables."):
+        if f.startswith(prefix):
+            kind = f[len(prefix):]
+            defaults = dict(_WEARABLE_DEFAULTS.get(kind, _WEARABLE_DEFAULTS["uci_har"]))
+            defaults.update(params)
+            return make_wearable_mlp(
+                input_dim=int(defaults["input_dim"]),
+                hidden_dims=tuple(defaults["hidden_dims"]),
+                num_classes=int(defaults["num_classes"]),
+                dropout=float(defaults.get("dropout", 0.3)),
+                name=f"wearables.{kind}",
+            )
+
+    raise ValueError(f"Unknown model factory: {factory!r}")

@@ -1,0 +1,19 @@
+"""Core numeric ops: pytree flattening, losses, uncertainty, count-sketch."""
+
+from murmura_tpu.ops.flatten import make_flatteners, model_dimension
+from murmura_tpu.ops.losses import (
+    evidential_loss,
+    masked_cross_entropy,
+    uncertainty_metrics,
+)
+from murmura_tpu.ops.sketch import count_sketch, make_sketch_tables
+
+__all__ = [
+    "make_flatteners",
+    "model_dimension",
+    "masked_cross_entropy",
+    "evidential_loss",
+    "uncertainty_metrics",
+    "count_sketch",
+    "make_sketch_tables",
+]

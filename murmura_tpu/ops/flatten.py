@@ -1,0 +1,41 @@
+"""Pytree <-> flat-vector utilities.
+
+The aggregation library operates on flattened float parameter vectors: one
+node's model is a row [P], the gathered network is [N, P] (reference
+counterpart: murmura/aggregation/base.py:138-170 ``flatten_model_state`` /
+``calculate_model_dimension``, applied per dict in Python; here flattening is
+a traced op so it fuses into the jitted round step).
+"""
+
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.flatten_util import ravel_pytree
+
+
+def make_flatteners(
+    template: Any,
+) -> Tuple[Callable[[Any], jnp.ndarray], Callable[[jnp.ndarray], Any], int]:
+    """Build (ravel, unravel, dim) for a single-node param pytree.
+
+    ``ravel`` and ``unravel`` are jit/vmap-compatible; vmap them to map
+    stacked [N, ...] params to the [N, P] neighbor tensor and back.
+    """
+    flat0, unravel = ravel_pytree(template)
+
+    def ravel(tree: Any) -> jnp.ndarray:
+        return ravel_pytree(tree)[0]
+
+    return ravel, unravel, int(flat0.size)
+
+
+def model_dimension(template: Any) -> int:
+    """Total float parameter count (reference: aggregation/base.py:155-170).
+
+    Works on concrete arrays and on ``jax.eval_shape`` ShapeDtypeStructs.
+    """
+    return sum(
+        int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(template)
+    )

@@ -1,0 +1,38 @@
+"""Count-Sketch compression (reference: murmura/aggregation/sketchguard.py:71-124).
+
+The reference computes the sketch host-side with ``np.bincount``; here it is
+``jax.ops.segment_sum`` of the sign-flipped parameter vector, so sketching all
+N nodes is one vmapped traced op inside the round step and the sketch itself
+is what would travel on the wire (sketchguard.py:126-155).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_sketch_tables(
+    model_dim: int, sketch_size: int, seed: int = 42
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Seeded hash/sign tables, matching the reference's RandomState draws
+    (sketchguard.py:71-76): hash ~ randint(0, sketch_size, model_dim),
+    sign ~ choice({-1,+1}, model_dim)."""
+    rng = np.random.RandomState(seed)
+    hash_table = rng.randint(0, sketch_size, size=model_dim).astype(np.int32)
+    sign_table = rng.choice([-1, 1], size=model_dim).astype(np.float32)
+    return hash_table, sign_table
+
+
+def count_sketch(
+    vector: jnp.ndarray,
+    hash_table: jnp.ndarray,
+    sign_table: jnp.ndarray,
+    sketch_size: int,
+) -> jnp.ndarray:
+    """Compress a [P] vector to a [sketch_size] Count-Sketch
+    (reference: sketchguard.py:91-112)."""
+    return jax.ops.segment_sum(
+        sign_table * vector, hash_table, num_segments=sketch_size
+    )

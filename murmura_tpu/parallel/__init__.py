@@ -1,0 +1,5 @@
+"""Device-mesh parallelism for the ``tpu`` backend."""
+
+from murmura_tpu.parallel.mesh import make_mesh, make_shardings, shard_step
+
+__all__ = ["make_mesh", "make_shardings", "shard_step"]

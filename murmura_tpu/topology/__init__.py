@@ -1,0 +1,7 @@
+"""Graph topologies for decentralized FL (reference: murmura/topology/)."""
+
+from murmura_tpu.topology.base import Topology
+from murmura_tpu.topology.generators import create_topology, TOPOLOGY_TYPES
+from murmura_tpu.topology.dynamic import MobilityModel
+
+__all__ = ["Topology", "create_topology", "MobilityModel", "TOPOLOGY_TYPES"]

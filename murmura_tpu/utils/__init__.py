@@ -1,0 +1,9 @@
+"""Shared wiring and utilities (reference: murmura/utils/)."""
+
+from murmura_tpu.utils.seed import set_seed
+from murmura_tpu.utils.factories import (
+    build_attack,
+    build_network_from_config,
+)
+
+__all__ = ["set_seed", "build_attack", "build_network_from_config"]

@@ -1,0 +1,161 @@
+"""Config -> object wiring shared by the CLI and backends
+(reference: murmura/utils/factories.py:16-190).
+
+``build_network_from_config`` is the single path from a validated Config to
+a ready-to-train Network for the simulation and tpu backends; the ZMQ
+distributed backend reuses the component builders for its per-process nodes.
+"""
+
+from typing import Optional
+
+from murmura_tpu.aggregation import build_aggregator
+from murmura_tpu.attacks import ATTACKS
+from murmura_tpu.attacks.base import Attack
+from murmura_tpu.config.schema import Config
+from murmura_tpu.core.network import Network
+from murmura_tpu.core.rounds import build_round_program
+from murmura_tpu.data.registry import build_federated_data
+from murmura_tpu.models.registry import build_model
+from murmura_tpu.topology.dynamic import MobilityModel
+from murmura_tpu.topology.generators import create_topology
+
+
+def build_attack(config: Config) -> Optional[Attack]:
+    """Instantiate the attack from config (reference: factories.py:123-174)."""
+    if not config.attack.enabled or not config.attack.type:
+        return None
+    n = config.topology.num_nodes
+    pct = config.attack.percentage
+    seed = config.experiment.seed
+    p = config.attack.params
+
+    if config.attack.type == "gaussian":
+        return ATTACKS["gaussian"](
+            num_nodes=n,
+            attack_percentage=pct,
+            noise_std=float(p.get("noise_std", 10.0)),
+            seed=seed,
+        )
+    if config.attack.type == "directed_deviation":
+        return ATTACKS["directed_deviation"](
+            num_nodes=n,
+            attack_percentage=pct,
+            lambda_param=float(p.get("lambda_param", -5.0)),
+            seed=seed,
+        )
+    if config.attack.type == "topology_liar":
+        inner = None
+        inner_type = p.get("model_attack_type")
+        if inner_type == "gaussian":
+            inner = ATTACKS["gaussian"](
+                num_nodes=n,
+                attack_percentage=pct,
+                noise_std=float(p.get("noise_std", 10.0)),
+                seed=seed,
+            )
+        elif inner_type == "directed_deviation":
+            inner = ATTACKS["directed_deviation"](
+                num_nodes=n,
+                attack_percentage=pct,
+                lambda_param=float(p.get("lambda_param", -5.0)),
+                seed=seed,
+            )
+        return ATTACKS["topology_liar"](
+            num_nodes=n, attack_percentage=pct, seed=seed, model_attack=inner
+        )
+    return None
+
+
+def build_mobility(config: Config) -> Optional[MobilityModel]:
+    """MobilityModel from config.mobility (reference: factories.py:177-190)."""
+    if config.mobility is None:
+        return None
+    m = config.mobility
+    return MobilityModel(
+        num_nodes=config.topology.num_nodes,
+        area_size=m.area_size,
+        comm_range=m.comm_range,
+        max_speed=m.max_speed,
+        seed=m.seed,
+        ensure_connected=m.ensure_connected,
+    )
+
+
+def build_network_from_config(config: Config, mesh=None) -> Network:
+    """Full wiring: data + model + aggregator + attack -> Network."""
+    n = config.topology.num_nodes
+    seed = config.experiment.seed
+    rounds = config.experiment.rounds
+
+    model = build_model(config.model.factory, config.model.params)
+    data = build_federated_data(
+        config.data.adapter,
+        config.data.params,
+        num_nodes=n,
+        seed=seed,
+        max_samples=config.training.max_samples,
+    )
+
+    topology = create_topology(
+        config.topology.type,
+        num_nodes=n,
+        p=config.topology.p,
+        k=config.topology.k,
+        seed=config.topology.seed,
+    )
+    attack = build_attack(config)
+    mobility = build_mobility(config)
+
+    # Probe sizing: evidential trust uses max_eval_samples
+    # (evidential_trust.py:62-63); loss-probe rules use one training batch
+    # (ubar.py:169).
+    agg_params = dict(config.aggregation.params)
+    if config.aggregation.algorithm == "evidential_trust":
+        probe_size = int(agg_params.get("max_eval_samples", 100))
+    else:
+        probe_size = config.training.batch_size
+
+    # Need model_dim for sketchguard before building the program: derive from
+    # a throwaway init (cheap, host-side).
+    import jax
+
+    from murmura_tpu.ops.flatten import model_dimension
+
+    model_dim = model_dimension(
+        jax.eval_shape(model.init, jax.random.PRNGKey(0))
+    )
+    agg = build_aggregator(
+        config.aggregation.algorithm, agg_params, model_dim=model_dim,
+        total_rounds=rounds,
+    )
+
+    program = build_round_program(
+        model,
+        agg,
+        data,
+        local_epochs=config.training.local_epochs,
+        batch_size=config.training.batch_size,
+        lr=config.training.lr,
+        total_rounds=rounds,
+        attack=attack,
+        seed=seed,
+        probe_size=probe_size,
+        annealing_rounds=max(1, rounds // 2),
+        lambda_weight=0.1,
+    )
+
+    if config.backend == "tpu" and mesh is None:
+        from murmura_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(config.tpu.num_devices)
+
+    return Network(
+        program=program,
+        topology=topology,
+        attack=attack,
+        mobility=mobility,
+        backend=config.backend if config.backend in ("simulation", "tpu") else "simulation",
+        mesh=mesh,
+        seed=seed,
+        donate=config.tpu.donate_state,
+    )

@@ -1,0 +1,16 @@
+"""Seeding (reference: murmura/utils/seed.py:8-21).
+
+JAX is functionally seeded (explicit PRNG keys threaded through the round
+step), so unlike the reference there is no hidden framework RNG state to
+pin; this helper seeds the host-side generators used by partitioners,
+topology generation, and attack selection.
+"""
+
+import random
+
+import numpy as np
+
+
+def set_seed(seed: int) -> None:
+    random.seed(seed)
+    np.random.seed(seed)

@@ -1,0 +1,29 @@
+"""Test harness config.
+
+Force an 8-virtual-device CPU platform BEFORE jax initializes so
+topology-masked collectives and the tpu backend's mesh sharding run without
+real TPU hardware (SURVEY.md §4 test plan item (c)).
+
+Note: tests must run in a fresh interpreter (pytest does this) — the env
+mutations below only take effect if jax has not yet been imported.  Clearing
+``PALLAS_AXON_POOL_IPS`` keeps test processes off the single-tenant TPU
+tunnel entirely.
+"""
+
+import os
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon TPU registration
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,80 @@
+"""Config schema + loader tests (reference surface: murmura/config/)."""
+
+import pytest
+
+from murmura_tpu.config import Config, load_config, save_config
+
+BASIC = {
+    "experiment": {"name": "t", "seed": 1, "rounds": 3},
+    "topology": {"type": "ring", "num_nodes": 4},
+    "aggregation": {"algorithm": "fedavg"},
+    "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+    "data": {"adapter": "synthetic", "params": {"num_samples": 64}},
+    "model": {"factory": "mlp", "params": {"input_dim": 32, "num_classes": 10}},
+}
+
+
+def test_defaults():
+    cfg = Config.model_validate(BASIC)
+    assert cfg.backend == "simulation"
+    assert cfg.attack.enabled is False
+    assert cfg.distributed.transport == "ipc"
+    assert cfg.tpu.exchange == "allgather"
+    assert cfg.mobility is None and cfg.dmtt is None
+
+
+def test_reference_yaml_surface_loads(tmp_path):
+    """A reference-style YAML (basic_fedavg shape) validates unchanged."""
+    yaml_text = """
+experiment:
+  name: "basic-fedavg-test"
+  seed: 42
+  rounds: 20
+  verbose: true
+topology:
+  type: "fully"
+  num_nodes: 5
+aggregation:
+  algorithm: "fedavg"
+  params: {}
+attack:
+  enabled: false
+training:
+  local_epochs: 3
+  batch_size: 64
+  lr: 0.001
+  max_samples: null
+data:
+  adapter: "leaf.femnist"
+  params:
+    synthetic: true
+model:
+  factory: "examples.leaf.LEAFFEMNISTModel"
+  params:
+    num_classes: 62
+"""
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml_text)
+    cfg = load_config(p)
+    assert cfg.topology.type == "fully"
+    assert cfg.model.factory == "examples.leaf.LEAFFEMNISTModel"
+
+
+def test_tpu_backend_enum():
+    cfg = Config.model_validate({**BASIC, "backend": "tpu"})
+    assert cfg.backend == "tpu"
+
+
+def test_extra_fields_forbidden():
+    with pytest.raises(Exception):
+        Config.model_validate({**BASIC, "bogus": 1})
+
+
+def test_roundtrip(tmp_path):
+    cfg = Config.model_validate(BASIC)
+    for name in ("c.yaml", "c.json"):
+        path = tmp_path / name
+        save_config(cfg, path)
+        again = load_config(path)
+        assert again.experiment.name == cfg.experiment.name
+        assert again.topology.num_nodes == 4

@@ -1,0 +1,109 @@
+"""Partitioner tests (reference semantics: murmura/data/partitioners.py)."""
+
+import numpy as np
+
+from murmura_tpu.data import (
+    combine_partitions_with_dirichlet,
+    dirichlet_partition,
+    iid_partition,
+    natural_partition,
+    stack_partitions,
+)
+
+
+def _labels(n=1000, k=10, seed=0):
+    return np.random.default_rng(seed).integers(0, k, size=n)
+
+
+def test_dirichlet_covers_all_samples_once():
+    y = _labels()
+    parts = dirichlet_partition(y, 8, alpha=0.5, seed=1)
+    all_idx = sorted(i for p in parts for i in p)
+    assert all_idx == list(range(1000))
+
+
+def test_dirichlet_min_samples():
+    y = _labels()
+    parts = dirichlet_partition(y, 10, alpha=0.05, min_samples_per_client=5, seed=2)
+    assert all(len(p) >= 5 for p in parts)
+
+
+def test_dirichlet_deterministic():
+    y = _labels()
+    a = dirichlet_partition(y, 5, alpha=0.3, seed=3)
+    b = dirichlet_partition(y, 5, alpha=0.3, seed=3)
+    assert a == b
+
+
+def test_dirichlet_heterogeneity_increases_with_small_alpha():
+    """Lower alpha -> more skewed label distributions (partitioners.py:22-26)."""
+    y = _labels(5000, 10)
+
+    def mean_label_entropy(parts):
+        ents = []
+        for p in parts:
+            counts = np.bincount(y[p], minlength=10) + 1e-9
+            probs = counts / counts.sum()
+            ents.append(-(probs * np.log(probs)).sum())
+        return np.mean(ents)
+
+    skewed = mean_label_entropy(dirichlet_partition(y, 10, alpha=0.05, seed=4))
+    uniform = mean_label_entropy(dirichlet_partition(y, 10, alpha=100.0, seed=4))
+    assert skewed < uniform
+
+
+def test_iid_even_split():
+    parts = iid_partition(103, 4, seed=0)
+    sizes = sorted(len(p) for p in parts)
+    assert sizes == [25, 26, 26, 26]
+    assert sorted(i for p in parts for i in p) == list(range(103))
+
+
+def test_natural_partition_groups_by_id():
+    ids = np.array([3, 1, 3, 2, 1, 1])
+    parts, n = natural_partition(ids)
+    assert n == 3
+    assert parts[0] == [1, 4, 5]  # id 1
+    assert parts[1] == [3]  # id 2
+    assert parts[2] == [0, 2]  # id 3
+
+
+def test_natural_partition_limit():
+    ids = np.array([0, 1, 2, 3, 4])
+    parts, n = natural_partition(ids, num_clients=3)
+    assert n == 3 and len(parts) == 3
+
+
+def test_combine_partitions_with_dirichlet_preserves_index_pool():
+    y = _labels(200, 5)
+    nat = [list(range(0, 100)), list(range(100, 200))]
+    parts = combine_partitions_with_dirichlet(nat, y, 4, alpha=0.5, seed=5)
+    assert sorted(i for p in parts for i in p) == list(range(200))
+
+
+def test_stack_partitions_padding_and_masks():
+    x = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10)
+    parts = [[0, 1, 2], [3], [4, 5, 6, 7, 8, 9]]
+    fed = stack_partitions(x, y, parts)
+    assert fed.x.shape == (3, 6, 2)
+    assert fed.num_samples.tolist() == [3, 1, 6]
+    assert fed.mask[1].tolist() == [1, 0, 0, 0, 0, 0]
+    fx, fy = fed.get_client_data(0)
+    assert fy.tolist() == [0, 1, 2]
+
+
+def test_effective_batch_rule():
+    """min(B, max(2, n)) and drop_last semantics (network.py:278-287)."""
+    x = np.zeros((30, 2), dtype=np.float32)
+    y = np.zeros(30, dtype=np.int64)
+    fed = stack_partitions(x, y, [[0], list(range(1, 6)), list(range(6, 30))])
+    assert fed.effective_batch(8).tolist() == [2, 5, 8]
+    assert fed.steps_per_epoch(8).tolist() == [1, 1, 3]
+
+
+def test_stack_partitions_max_samples_truncation():
+    x = np.zeros((30, 2), dtype=np.float32)
+    y = np.zeros(30, dtype=np.int64)
+    fed = stack_partitions(x, y, [list(range(30))], max_samples=7)
+    assert fed.num_samples.tolist() == [7]

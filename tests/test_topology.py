@@ -1,0 +1,97 @@
+"""Topology generator tests (reference semantics: murmura/topology/generators.py)."""
+
+import numpy as np
+import pytest
+
+from murmura_tpu.topology import MobilityModel, Topology, create_topology
+
+
+def test_ring():
+    t = create_topology("ring", 6)
+    assert t.neighbors[0] == [1, 5]
+    assert all(t.degree(i) == 2 for i in range(6))
+    assert t.is_connected()
+    assert np.array_equal(t.adjacency, t.adjacency.T)
+
+
+def test_fully():
+    t = create_topology("fully", 5)
+    assert all(t.degree(i) == 4 for i in range(5))
+    assert len(t.edges) == 10
+
+
+def test_erdos_deterministic_and_no_isolated():
+    a = create_topology("erdos", 20, p=0.1, seed=7)
+    b = create_topology("erdos", 20, p=0.1, seed=7)
+    assert np.array_equal(a.adjacency, b.adjacency)
+    assert all(a.degree(i) >= 1 for i in range(20))
+    c = create_topology("erdos", 20, p=0.1, seed=8)
+    assert not np.array_equal(a.adjacency, c.adjacency)
+
+
+def test_erdos_p_validation():
+    with pytest.raises(ValueError):
+        create_topology("erdos", 5, p=1.5)
+
+
+def test_k_regular():
+    t = create_topology("k-regular", 10, k=4)
+    assert all(t.degree(i) == 4 for i in range(10))
+    assert t.neighbors[0] == [1, 2, 8, 9]
+
+
+def test_k_regular_odd_k_bumped():
+    t = create_topology("k-regular", 10, k=3)  # odd -> 4 (generators.py:116-118)
+    assert all(t.degree(i) == 4 for i in range(10))
+
+
+def test_k_regular_k_ge_n_fully():
+    t = create_topology("k-regular", 4, k=6)  # k >= n -> fully (generators.py:120-122)
+    assert all(t.degree(i) == 3 for i in range(4))
+
+
+def test_unknown_type():
+    with pytest.raises(ValueError):
+        create_topology("torus", 4)
+
+
+def test_from_neighbors_roundtrip():
+    t = create_topology("ring", 5)
+    t2 = Topology.from_neighbors(5, t.neighbors)
+    assert np.array_equal(t.adjacency, t2.adjacency)
+
+
+class TestMobility:
+    def test_deterministic_reconstruction(self):
+        """Two instances with the same seed produce identical G^t — the
+        property DMTT claim-verification relies on (dynamic.py:1-8)."""
+        a = MobilityModel(8, seed=3)
+        b = MobilityModel(8, seed=3)
+        for r in (0, 3, 7):
+            assert np.array_equal(a.adjacency_at(r), b.adjacency_at(r))
+
+    def test_positions_wrap_torus(self):
+        m = MobilityModel(4, area_size=10.0, max_speed=50.0, seed=0)
+        pos = m.positions_at(5)
+        assert (pos >= 0).all() and (pos < 10.0).all()
+
+    def test_ensure_connected_attaches_isolated(self):
+        m = MobilityModel(10, area_size=1000.0, comm_range=5.0, seed=0)
+        adj = m.adjacency_at(0)
+        assert all(adj[i].any() for i in range(10))
+
+    def test_no_self_edges_and_symmetric(self):
+        m = MobilityModel(6, seed=1)
+        adj = m.adjacency_at(2)
+        assert not np.diag(adj).any()
+        assert np.array_equal(adj, adj.T)
+
+    def test_comm_range_edge_rule(self):
+        m = MobilityModel(5, area_size=100.0, comm_range=30.0, seed=2,
+                          ensure_connected=False)
+        adj = m.adjacency_at(1)
+        pos = m.positions_at(1)
+        for i in range(5):
+            for j in range(i + 1, 5):
+                d = m.torus_dist(i, j, 1)
+                assert bool(adj[i, j]) == (d < 30.0)

@@ -1,0 +1,73 @@
+"""Benchmark: FL rounds/sec on the flagship Byzantine scenario.
+
+Scenario (BASELINE.json config #2): Krum aggregation, 20-node k-regular(4)
+topology, 20% Gaussian-Byzantine nodes, FEMNIST baseline CNN (~6.5M params),
+one local epoch per round.  Data is FEMNIST-shaped synthetic (28x28x1, 62
+classes; zero-egress environment).  The whole round — local SGD, attack,
+adjacency-masked exchange, Krum selection over the gathered [N, P] tensor,
+eval — is one jitted program on the default device (the real TPU chip under
+the driver).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no throughput numbers (BASELINE.md); vs_baseline is
+measured against the north-star target of 50 FL rounds/sec (BASELINE.json).
+"""
+
+import json
+import time
+
+
+def main():
+    from murmura_tpu.config import Config
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    num_nodes = 20
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": "bench-krum-femnist", "seed": 7, "rounds": 10},
+            "topology": {"type": "k-regular", "num_nodes": num_nodes, "k": 4},
+            "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+            "attack": {
+                "enabled": True,
+                "type": "gaussian",
+                "percentage": 0.2,
+                "params": {"noise_std": 10.0},
+            },
+            "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+            "data": {
+                "adapter": "synthetic",
+                "params": {
+                    "num_samples": 160 * num_nodes,
+                    "input_shape": [28, 28, 1],
+                    "num_classes": 62,
+                },
+            },
+            "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
+        }
+    )
+
+    network = build_network_from_config(cfg)
+
+    # Warmup: compile + 2 steady-state rounds.
+    network.train(rounds=3)
+
+    timed_rounds = 10
+    t0 = time.perf_counter()
+    network.train(rounds=timed_rounds)
+    elapsed = time.perf_counter() - t0
+
+    rounds_per_sec = timed_rounds / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "fl_rounds_per_sec_krum_femnist_cnn_20node",
+                "value": round(rounds_per_sec, 3),
+                "unit": "rounds/sec",
+                "vs_baseline": round(rounds_per_sec / 50.0, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

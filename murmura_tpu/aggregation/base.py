@@ -57,7 +57,13 @@ class AggContext:
 
 @dataclass(frozen=True)
 class AggregatorDef:
-    """A named aggregation rule with optional carried state."""
+    """A named aggregation rule with optional carried state.
+
+    ``state_kind`` maps each carried-state key to its indexing scheme:
+    'node' = leading axis is the node id (e.g. acceptance windows), 'edge' =
+    [N, N] directed-edge matrix (e.g. smoothed trust).  The ZMQ distributed
+    backend uses this to project the stacked state onto one process's view.
+    """
 
     name: str
     aggregate: Callable[
@@ -66,6 +72,7 @@ class AggregatorDef:
     ]
     init_state: Callable[[int], AggState] = field(default=lambda num_nodes: {})
     needs_probe: bool = False
+    state_kind: Dict[str, str] = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------------

@@ -152,4 +152,5 @@ def make_evidential_trust(
         aggregate=aggregate,
         init_state=init_state,
         needs_probe=True,
+        state_kind={"smoothed_trust": "edge", "trust_seen": "edge"},
     )

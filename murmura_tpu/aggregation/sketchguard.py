@@ -94,5 +94,8 @@ def make_sketchguard(
         return new_flat, new_state, stats
 
     return AggregatorDef(
-        name="sketchguard", aggregate=aggregate, init_state=init_state
+        name="sketchguard",
+        aggregate=aggregate,
+        init_state=init_state,
+        state_kind={"acc_window": "node", "window_len": "node"},
     )

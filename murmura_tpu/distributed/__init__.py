@@ -1,0 +1,18 @@
+"""ZeroMQ multi-process backend (reference: murmura/distributed/).
+
+Retained for capability parity as the non-TPU multi-machine path (SURVEY.md
+§5 north star: "alongside the existing simulation and ZMQ-distributed
+backends").  One OS process per FL node plus a passive monitor; round
+boundaries are wall-clock (t_start + k * round_duration_s) with no control
+messages; fault tolerance is deadline-based partial aggregation
+(reference: murmura/distributed/node_process.py:8-12, 249-276).
+
+The TPU backend replaces all of this with mesh collectives (parallel/mesh.py);
+this package exists so experiments that need share-nothing processes (e.g.
+real multi-machine deployments without TPU interconnect) keep working.
+"""
+
+from murmura_tpu.distributed.endpoints import Endpoints
+from murmura_tpu.distributed.messaging import MsgType, encode, decode
+
+__all__ = ["Endpoints", "MsgType", "encode", "decode"]

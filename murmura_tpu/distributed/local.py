@@ -1,0 +1,280 @@
+"""Single-node runtime for the ZMQ backend.
+
+One process owns one FL node (reference: murmura/core/node.py:14-252 held by
+murmura/distributed/node_process.py).  Training/eval are small jitted CPU
+programs; aggregation reuses the SAME pure vectorized rules as the
+simulation/tpu backends by building a fixed-size mini-network tensor —
+slot 0 is this node, slots 1..M-1 hold the neighbor states that arrived
+before the round deadline (missing neighbors are masked out of the
+adjacency row, reproducing the reference's partial-aggregation semantics,
+node_process.py:259-269).  A fixed M = 1 + max_degree keeps shapes static so
+nothing recompiles as the arrival set varies round to round.
+
+Known tradeoff: reusing the square network-wide rules means the mini network
+computes all M rows (and, for probe-based rules, M^2 cross-evaluations)
+although only row 0 is consumed — an O(degree) overhead per process accepted
+to keep one implementation of every rule.  The TPU backend has no such
+waste (every row of the global computation belongs to a real node); if ZMQ
+per-round CPU cost ever matters, specialize pairwise_probe_eval to a single
+evaluator row here.
+"""
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from murmura_tpu.aggregation.base import AggContext, AggregatorDef
+from murmura_tpu.models.core import Model
+from murmura_tpu.ops.flatten import make_flatteners
+from murmura_tpu.ops.losses import (
+    evidential_loss,
+    masked_cross_entropy,
+    uncertainty_metrics,
+)
+
+
+class LocalNode:
+    """One FL peer: local SGD, masked eval, rule-based aggregation."""
+
+    def __init__(
+        self,
+        node_id: int,
+        model: Model,
+        agg: AggregatorDef,
+        x: np.ndarray,
+        y: np.ndarray,
+        *,
+        max_neighbors: int,
+        local_epochs: int = 1,
+        batch_size: int = 64,
+        lr: float = 0.01,
+        total_rounds: int = 20,
+        probe_size: Optional[int] = None,
+        annealing_rounds: int = 10,
+        lambda_weight: float = 0.1,
+        seed: int = 42,
+    ):
+        self.node_id = node_id
+        self.model = model
+        self.agg = agg
+        self.total_rounds = total_rounds
+        self.mini_n = 1 + max_neighbors
+
+        n_samples = len(y)
+        self.x = jnp.asarray(x)
+        self.y = jnp.asarray(y, jnp.int32)
+        self.n_samples = n_samples
+        # reference batch rule (network.py:278-287)
+        self.eff_batch = int(min(batch_size, max(2, n_samples)))
+        self.steps = n_samples // self.eff_batch if n_samples > self.eff_batch else 1
+        self.local_epochs = local_epochs
+        self.lr = lr
+        self.evidential = model.evidential
+        self.num_classes = model.num_classes
+        self.annealing_rounds = annealing_rounds
+        self.lambda_weight = lambda_weight
+
+        self.rng = jax.random.PRNGKey(seed)
+        self.params = model.init(jax.random.PRNGKey(seed))
+        self._ravel, self._unravel, self.model_dim = make_flatteners(self.params)
+
+        p_size = int(min(n_samples, probe_size or self.eff_batch))
+        self._probe_x = self.x[:p_size]
+        self._probe_y = self.y[:p_size]
+        self._probe_mask = jnp.ones((p_size,), jnp.float32)
+
+        # Per-rule carried state, projected per AggregatorDef.state_kind.
+        template = agg.init_state(self.mini_n)
+        unknown = [k for k in template if agg.state_kind.get(k) not in ("node", "edge")]
+        if unknown:
+            raise ValueError(
+                f"Aggregator '{agg.name}' carries state keys {unknown} without a "
+                "state_kind annotation — the distributed backend cannot project "
+                "them per-neighbor and would silently reset them every round"
+            )
+        self._node_state = {
+            k: np.asarray(v[0]) for k, v in template.items()
+            if agg.state_kind.get(k) == "node"
+        }
+        self._edge_state: Dict[str, Dict[int, np.ndarray]] = {
+            k: {} for k, v in template.items() if agg.state_kind.get(k) == "edge"
+        }
+        self._state_template = {k: np.asarray(v) for k, v in template.items()}
+
+        self._train_fn = jax.jit(self._build_train_fn())
+        self._eval_fn = jax.jit(self._build_eval_fn())
+        self._agg_fn = jax.jit(self._build_agg_fn())
+        self._last_stats: Dict[str, float] = {}
+
+    # ------------------------------------------------------------------
+    # training
+    # ------------------------------------------------------------------
+
+    def _build_train_fn(self):
+        model = self.model
+        n, b, steps = self.n_samples, self.eff_batch, self.steps
+        evidential, num_classes = self.evidential, self.num_classes
+        annealing, lam_w = self.annealing_rounds, self.lambda_weight
+        lr, epochs = self.lr, self.local_epochs
+
+        def loss_fn(params, xb, yb, key, round_idx):
+            out = model.apply(params, xb, key, True)
+            if evidential:
+                lam = jnp.minimum(1.0, round_idx / max(1, annealing)) * lam_w
+                return evidential_loss(out, yb, jnp.ones(xb.shape[0]), num_classes, lam)
+            loss, _ = masked_cross_entropy(out, yb, jnp.ones(xb.shape[0]))
+            return loss
+
+        grad_fn = jax.grad(loss_fn)
+
+        def train(params, key, round_idx):
+            def epoch(params, ekey):
+                pkey, skey = jax.random.split(ekey)
+                perm = jax.random.permutation(pkey, n)
+
+                def step(params, t):
+                    pos = (t * b + jnp.arange(b)) % n
+                    idx = perm[pos]
+                    g = grad_fn(
+                        params, self.x[idx], self.y[idx],
+                        jax.random.fold_in(skey, t), round_idx,
+                    )
+                    return jax.tree_util.tree_map(
+                        lambda p, gg: p - lr * gg, params, g
+                    ), None
+
+                params, _ = jax.lax.scan(step, params, jnp.arange(steps))
+                return params, None
+
+            params, _ = jax.lax.scan(epoch, params, jax.random.split(key, epochs))
+            return params
+
+        return train
+
+    def _build_eval_fn(self):
+        model = self.model
+        evidential = self.evidential
+
+        def evaluate(params):
+            out = model.apply(params, self.x, None, False)
+            mask = jnp.ones((self.x.shape[0],), jnp.float32)
+            if evidential:
+                unc = uncertainty_metrics(out)
+                probs = unc["probs"]
+                nll = -jnp.log(
+                    jnp.take_along_axis(probs, self.y[:, None], axis=-1)[:, 0] + 1e-10
+                )
+                acc = (jnp.argmax(out, -1) == self.y).mean()
+                return {
+                    "loss": nll.mean(),
+                    "accuracy": acc,
+                    "vacuity": unc["vacuity"].mean(),
+                    "entropy": unc["entropy"].mean(),
+                    "strength": unc["strength"].mean(),
+                }
+            loss, acc = masked_cross_entropy(out, self.y, mask)
+            return {"loss": loss, "accuracy": acc}
+
+        return evaluate
+
+    # ------------------------------------------------------------------
+    # aggregation via the shared vectorized rules
+    # ------------------------------------------------------------------
+
+    def _build_agg_fn(self):
+        m = self.mini_n
+        agg = self.agg
+        ctx = AggContext(
+            apply_fn=self.model.apply,
+            unravel=self._unravel,
+            probe_x=jnp.tile(self._probe_x[None], (m,) + (1,) * self._probe_x.ndim),
+            probe_y=jnp.tile(self._probe_y[None], (m, 1)),
+            probe_mask=jnp.tile(self._probe_mask[None], (m, 1)),
+            evidential=self.evidential,
+            num_classes=self.num_classes,
+            total_rounds=self.total_rounds,
+        )
+
+        def aggregate(own_flat, neighbor_flats, neighbor_mask, round_idx, state):
+            # mini network: slot 0 = self, slots 1.. = neighbors
+            flats = jnp.concatenate([own_flat[None], neighbor_flats], axis=0)
+            adj = jnp.zeros((m, m), jnp.float32)
+            adj = adj.at[0, 1:].set(neighbor_mask)
+            adj = adj.at[1:, 0].set(neighbor_mask)
+            new_flat, new_state, stats = agg.aggregate(
+                flats, flats, adj, round_idx, state, ctx
+            )
+            row_stats = {k: v[0] for k, v in stats.items()}
+            return new_flat[0], new_state, row_stats
+
+        return aggregate
+
+    def _mini_state(self, neighbor_ids: List[int]) -> Dict[str, jnp.ndarray]:
+        state = {}
+        for k, template in self._state_template.items():
+            arr = np.array(template)
+            kind = self.agg.state_kind.get(k)
+            if kind == "node":
+                arr[0] = self._node_state[k]
+            elif kind == "edge":
+                for slot, nid in enumerate(neighbor_ids, start=1):
+                    if nid in self._edge_state[k]:
+                        arr[0, slot] = self._edge_state[k][nid]
+            state[k] = jnp.asarray(arr)
+        return state
+
+    def _store_state(self, state, neighbor_ids: List[int]) -> None:
+        for k in self._state_template:
+            kind = self.agg.state_kind.get(k)
+            arr = np.asarray(state[k])
+            if kind == "node":
+                self._node_state[k] = arr[0]
+            elif kind == "edge":
+                for slot, nid in enumerate(neighbor_ids, start=1):
+                    self._edge_state[k][nid] = arr[0, slot]
+
+    # ------------------------------------------------------------------
+    # public API (reference Node surface: core/node.py:59-252)
+    # ------------------------------------------------------------------
+
+    def local_train(self, round_idx: int) -> None:
+        self.rng, key = jax.random.split(self.rng)
+        self.params = self._train_fn(
+            self.params, key, jnp.asarray(round_idx, jnp.float32)
+        )
+
+    def get_flat_state(self) -> np.ndarray:
+        return np.asarray(self._ravel(self.params), dtype=np.float32)
+
+    def set_flat_state(self, flat: np.ndarray) -> None:
+        self.params = self._unravel(jnp.asarray(flat))
+
+    def evaluate(self) -> Dict[str, float]:
+        return {k: float(v) for k, v in self._eval_fn(self.params).items()}
+
+    def aggregate_with_neighbors(
+        self, neighbor_states: Dict[int, np.ndarray], round_num: int
+    ) -> None:
+        """Aggregate own params with the received subset (partial OK)."""
+        neighbor_ids = sorted(neighbor_states)[: self.mini_n - 1]
+        flats = np.zeros((self.mini_n - 1, self.model_dim), np.float32)
+        mask = np.zeros((self.mini_n - 1,), np.float32)
+        for slot, nid in enumerate(neighbor_ids):
+            flats[slot] = neighbor_states[nid]
+            mask[slot] = 1.0
+        state = self._mini_state(neighbor_ids)
+        new_flat, new_state, stats = self._agg_fn(
+            self._ravel(self.params),
+            jnp.asarray(flats),
+            jnp.asarray(mask),
+            jnp.asarray(float(round_num)),
+            state,
+        )
+        self.params = self._unravel(new_flat)
+        self._store_state(new_state, neighbor_ids)
+        self._last_stats = {k: float(v) for k, v in stats.items()}
+
+    def get_aggregator_statistics(self) -> Dict[str, float]:
+        return dict(self._last_stats)

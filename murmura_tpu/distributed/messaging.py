@@ -1,0 +1,57 @@
+"""Wire format (reference: murmura/distributed/messaging.py:11-78).
+
+2-frame multipart: header = struct("!Bi") (1-byte MsgType + 4-byte sender
+id), then the payload.  Model states travel as flattened float32 parameter
+vectors serialized with numpy (the reference ships full torch state dicts
+via torch.save — flat vectors are both smaller and exactly what the
+aggregation rules consume); metrics/claims use pickle.
+"""
+
+import io
+import pickle
+import struct
+from enum import IntEnum
+from typing import Any, Tuple
+
+import numpy as np
+
+_HEADER = struct.Struct("!Bi")
+
+
+class MsgType(IntEnum):
+    MODEL_STATE = 1
+    METRICS = 2
+    TOPO_CLAIM = 3
+
+
+def pack_state(flat: np.ndarray) -> bytes:
+    """Serialize a flat float32 parameter vector."""
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(flat, dtype=np.float32), allow_pickle=False)
+    return buf.getvalue()
+
+
+def unpack_state(payload: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(payload), allow_pickle=False)
+
+
+def pack_obj(obj: Any) -> bytes:
+    """Serialize metrics / topology claims."""
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def unpack_obj(payload: bytes) -> Any:
+    return pickle.loads(payload)
+
+
+def encode(msg_type: MsgType, sender: int, payload: bytes) -> Tuple[bytes, bytes]:
+    """Build the 2-frame multipart message."""
+    return _HEADER.pack(int(msg_type), sender), payload
+
+
+def decode(frames) -> Tuple[MsgType, int, bytes]:
+    """Parse a received multipart message."""
+    if len(frames) != 2:
+        raise ValueError(f"Expected 2 frames, got {len(frames)}")
+    msg_type, sender = _HEADER.unpack(frames[0])
+    return MsgType(msg_type), sender, frames[1]

@@ -1,0 +1,142 @@
+"""Passive metrics monitor (reference: murmura/distributed/monitor.py:6-175).
+
+PULL-only collector: its death cannot affect training.  Metrics are buffered
+keyed by (round, node); complete rounds flush in order; a hard deadline
+(t_start + rounds*duration + 2*duration) forces a partial flush of whatever
+arrived.  Produces a history dict with the same schema as Network.train
+(reference: monitor.py:49-59 vs network.py:47-58).
+"""
+
+import time
+from typing import Any, Dict, List, Optional, Set
+
+import numpy as np
+
+from murmura_tpu.config.schema import Config
+from murmura_tpu.distributed.endpoints import Endpoints
+from murmura_tpu.distributed.messaging import MsgType, decode, unpack_obj
+
+
+class Monitor:
+    def __init__(
+        self,
+        config: Config,
+        run_id: str,
+        t_start: float,
+        compromised_ids: Optional[Set[int]] = None,
+    ):
+        self.config = config
+        self.endpoints = Endpoints(config.distributed, run_id)
+        self.t_start = t_start
+        self.num_nodes = config.topology.num_nodes
+        self.rounds = config.experiment.rounds
+        self.round_duration = config.distributed.round_duration_s
+        self.compromised = compromised_ids or set()
+
+        self.history: Dict[str, List[Any]] = {
+            "round": [],
+            "mean_accuracy": [],
+            "std_accuracy": [],
+            "mean_loss": [],
+            "honest_accuracy": [],
+            "compromised_accuracy": [],
+            "mean_vacuity": [],
+            "mean_entropy": [],
+            "mean_strength": [],
+        }
+        self._buffer: Dict[int, Dict[int, dict]] = {}
+        self._flushed_through = -1
+
+    def run(self) -> Dict[str, List[Any]]:
+        import zmq
+
+        ctx = zmq.Context()
+        sock = ctx.socket(zmq.PULL)
+        sock.bind(self.endpoints.monitor_bind())
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+
+        hard_deadline = (
+            self.t_start + self.rounds * self.round_duration + 2 * self.round_duration
+        )
+        try:
+            while time.monotonic() < hard_deadline:
+                if self._flushed_through >= self.rounds - 1:
+                    break
+                events = dict(poller.poll(200))
+                if sock in events:
+                    msg_type, sender, payload = decode(sock.recv_multipart())
+                    if msg_type == MsgType.METRICS:
+                        self._ingest(unpack_obj(payload))
+                self._flush_complete()
+            self._flush_partial()
+        finally:
+            sock.close()
+            ctx.term()
+        return self.history
+
+    # ------------------------------------------------------------------
+
+    def _ingest(self, metrics: dict) -> None:
+        r = int(metrics.get("round", -1))
+        n = int(metrics.get("node", -1))
+        if r < 0 or n < 0:
+            return
+        self._buffer.setdefault(r, {})[n] = metrics
+
+    def _flush_complete(self) -> None:
+        """Flush rounds in order while fully reported (monitor.py:81-108)."""
+        while True:
+            nxt = self._flushed_through + 1
+            if nxt >= self.rounds or len(self._buffer.get(nxt, {})) < self.num_nodes:
+                return
+            self._record_round(nxt, self._buffer.pop(nxt))
+            self._flushed_through = nxt
+
+    def _flush_partial(self) -> None:
+        """Hard deadline passed: flush incomplete rounds in order
+        (monitor.py:110-128)."""
+        for r in sorted(self._buffer):
+            if r > self._flushed_through and self._buffer[r]:
+                self._record_round(r, self._buffer[r])
+                self._flushed_through = r
+        self._buffer.clear()
+
+    def _record_round(self, round_idx: int, per_node: Dict[int, dict]) -> None:
+        rows = [m for m in per_node.values() if not m.get("skipped")]
+        if not rows:
+            return
+        accs = np.array([m.get("accuracy", 0.0) for m in rows])
+        losses = np.array([m.get("loss", 0.0) for m in rows])
+        self.history["round"].append(round_idx + 1)
+        self.history["mean_accuracy"].append(float(accs.mean()))
+        self.history["std_accuracy"].append(float(accs.std()))
+        self.history["mean_loss"].append(float(losses.mean()))
+
+        if self.compromised:
+            honest = [
+                m.get("accuracy", 0.0)
+                for m in rows
+                if not m.get("compromised", False)
+            ]
+            comp = [
+                m.get("accuracy", 0.0) for m in rows if m.get("compromised", False)
+            ]
+            # NaN placeholders keep every history list index-aligned with
+            # 'round' even when a partial flush lost one class's reports.
+            self.history["honest_accuracy"].append(
+                float(np.mean(honest)) if honest else float("nan")
+            )
+            self.history["compromised_accuracy"].append(
+                float(np.mean(comp)) if comp else float("nan")
+            )
+
+        vacs = [m["vacuity"] for m in rows if "vacuity" in m]
+        if vacs:
+            self.history["mean_vacuity"].append(float(np.mean(vacs)))
+            self.history["mean_entropy"].append(
+                float(np.mean([m["entropy"] for m in rows]))
+            )
+            self.history["mean_strength"].append(
+                float(np.mean([m["strength"] for m in rows]))
+            )

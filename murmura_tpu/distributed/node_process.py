@@ -1,0 +1,329 @@
+"""Per-node worker process (reference: murmura/distributed/node_process.py:8-364).
+
+Socket layout: one PULL bind (receives from neighbors), lazy PUSH per
+neighbor, one PUSH to the monitor.  Round protocol: sleep until
+t_start + k*round_duration -> local train (honest only) -> overrun check ->
+attack own outgoing state -> PUSH to current neighbors -> PULL until all
+expected arrived or deadline (aggregate with whatever arrived) -> aggregate
+-> evaluate -> PUSH metrics.  Round sync is the system clock; there are no
+control messages.
+"""
+
+import os
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from murmura_tpu.config.schema import Config
+from murmura_tpu.distributed.endpoints import Endpoints
+from murmura_tpu.distributed.messaging import (
+    MsgType,
+    decode,
+    encode,
+    pack_obj,
+    pack_state,
+    unpack_state,
+)
+
+
+def _force_cpu_jax() -> None:
+    """Child processes must not contend for the single-tenant TPU; local
+    training in the ZMQ backend runs on CPU (the tpu backend is the device
+    path)."""
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+class NodeProcess:
+    """One FL node in its own OS process."""
+
+    def __init__(
+        self,
+        config: Config,
+        node_id: int,
+        run_id: str,
+        t_start: float,
+        compromised_ids: List[int],
+        host: Optional[str] = None,
+    ):
+        self.config = config
+        self.node_id = node_id
+        self.run_id = run_id
+        self.t_start = t_start
+        self.compromised_ids = set(compromised_ids)
+        self.host = host
+        self.is_compromised = node_id in self.compromised_ids
+
+        self.endpoints = Endpoints(config.distributed, run_id)
+        self.rounds = config.experiment.rounds
+        self.round_duration = config.distributed.round_duration_s
+
+        self.node = None
+        self.attack = None
+        self.mobility = None
+        self.static_neighbors: List[int] = []
+        self._ctx = None
+        self._pull = None
+        self._push: Dict[int, object] = {}
+        self._monitor_push = None
+
+    # ------------------------------------------------------------------
+
+    def run(self) -> None:
+        """Entry point inside the child process (reference: node_process.py:111-124)."""
+        _force_cpu_jax()
+        from murmura_tpu.utils.seed import set_seed
+
+        # per-node seeding (node_process.py:113)
+        set_seed(self.config.experiment.seed + self.node_id)
+        self._build_node()
+        self._setup_sockets()
+        try:
+            self._run_all_rounds()
+        finally:
+            self._teardown()
+
+    # ------------------------------------------------------------------
+
+    def _build_node(self) -> None:
+        """Factories + full dataset load in every process, then subset
+        (reference behavior: node_process.py:333-364)."""
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.data.registry import build_federated_data
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.models.registry import build_model
+        from murmura_tpu.topology.generators import create_topology
+        from murmura_tpu.utils.factories import build_attack, build_mobility
+
+        cfg = self.config
+        model = build_model(cfg.model.factory, cfg.model.params)
+        data = build_federated_data(
+            cfg.data.adapter,
+            cfg.data.params,
+            num_nodes=cfg.topology.num_nodes,
+            seed=cfg.experiment.seed,
+            max_samples=cfg.training.max_samples,
+        )
+        x, y = data.get_client_data(self.node_id)
+
+        self.mobility = build_mobility(cfg)
+        if self.mobility is None:
+            topo = create_topology(
+                cfg.topology.type,
+                num_nodes=cfg.topology.num_nodes,
+                p=cfg.topology.p,
+                k=cfg.topology.k,
+                seed=cfg.topology.seed,
+            )
+            self.static_neighbors = topo.neighbors[self.node_id]
+            max_deg = max(len(ns) for ns in topo.neighbors)
+        else:
+            max_deg = cfg.topology.num_nodes - 1
+
+        self.attack = build_attack(cfg)
+
+        from murmura_tpu.ops.flatten import model_dimension
+        import jax
+
+        model_dim = model_dimension(jax.eval_shape(model.init, jax.random.PRNGKey(0)))
+        agg_params = dict(cfg.aggregation.params)
+        if cfg.aggregation.algorithm == "evidential_trust":
+            probe_size = int(agg_params.get("max_eval_samples", 100))
+        else:
+            probe_size = cfg.training.batch_size
+        agg = build_aggregator(
+            cfg.aggregation.algorithm, agg_params, model_dim=model_dim,
+            total_rounds=cfg.experiment.rounds,
+        )
+
+        self.node = LocalNode(
+            node_id=self.node_id,
+            model=model,
+            agg=agg,
+            x=x,
+            y=y,
+            max_neighbors=max_deg,
+            local_epochs=cfg.training.local_epochs,
+            batch_size=cfg.training.batch_size,
+            lr=cfg.training.lr,
+            total_rounds=cfg.experiment.rounds,
+            probe_size=probe_size,
+            annealing_rounds=max(1, cfg.experiment.rounds // 2),
+            seed=cfg.experiment.seed + self.node_id,
+        )
+
+    def _setup_sockets(self) -> None:
+        """PULL bind + PUSH to monitor; neighbor PUSH sockets are lazy
+        (reference: node_process.py:130-155)."""
+        import zmq
+
+        self._ctx = zmq.Context()
+        self._pull = self._ctx.socket(zmq.PULL)
+        self._pull.bind(self.endpoints.node_bind(self.node_id, self.host))
+        self._monitor_push = self._ctx.socket(zmq.PUSH)
+        self._monitor_push.setsockopt(zmq.LINGER, 2000)
+        self._monitor_push.connect(self.endpoints.monitor_connect())
+
+    def _push_to(self, neighbor_id: int):
+        import zmq
+
+        if neighbor_id not in self._push:
+            sock = self._ctx.socket(zmq.PUSH)
+            sock.setsockopt(zmq.LINGER, 2000)
+            sock.connect(self.endpoints.node_connect(neighbor_id))
+            self._push[neighbor_id] = sock
+        return self._push[neighbor_id]
+
+    def _teardown(self) -> None:
+        for sock in self._push.values():
+            sock.close()
+        if self._pull is not None:
+            self._pull.close()
+        if self._monitor_push is not None:
+            self._monitor_push.close()
+        if self._ctx is not None:
+            self._ctx.term()
+
+    # ------------------------------------------------------------------
+
+    def current_neighbors(self, round_idx: int) -> List[int]:
+        """Static topology or mobility G^t (reference: node_process.py:292-323)."""
+        if self.mobility is not None:
+            return self.mobility.neighbors_at(round_idx)[self.node_id]
+        return list(self.static_neighbors)
+
+    def _run_all_rounds(self) -> None:
+        for k in range(self.rounds):
+            target = self.t_start + k * self.round_duration
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            self._execute_round(k)
+
+    def _execute_round(self, round_idx: int) -> None:
+        """One wall-clock round (reference: node_process.py:193-247)."""
+        deadline = self.t_start + (round_idx + 1) * self.round_duration
+        neighbors = self.current_neighbors(round_idx)
+
+        # 1. local training (honest only — node_process.py:205-207)
+        if not self.is_compromised:
+            self.node.local_train(round_idx)
+
+        # 2. overrun check: skip exchange if training blew the window
+        # (node_process.py:210-218)
+        if time.monotonic() >= deadline:
+            print(
+                f"[node {self.node_id}] round {round_idx}: training overran "
+                "the round window; skipping exchange",
+                flush=True,
+            )
+            self._send_metrics(round_idx, skipped=True)
+            return
+
+        # 3. attack own outgoing state (node_process.py:221-225)
+        flat = self.node.get_flat_state()
+        out_flat = self._attacked_state(flat, round_idx)
+
+        # 4. PUSH to current neighbors (node_process.py:227-232)
+        payload = pack_state(out_flat)
+        for nid in neighbors:
+            try:
+                self._push_to(nid).send_multipart(
+                    encode(MsgType.MODEL_STATE, self.node_id, payload), copy=False
+                )
+            except Exception as e:  # pragma: no cover - socket teardown races
+                print(f"[node {self.node_id}] push to {nid} failed: {e}", flush=True)
+
+        # 5. collect neighbor states until expected or deadline
+        # (node_process.py:249-276)
+        received = self._collect_states(set(neighbors), deadline)
+
+        # 6. aggregate with whatever arrived (partial OK)
+        if received:
+            self.node.aggregate_with_neighbors(received, round_idx)
+
+        # 7. evaluate + metrics to monitor
+        self._send_metrics(round_idx, skipped=False)
+
+    def _attacked_state(self, flat: np.ndarray, round_idx: int) -> np.ndarray:
+        if self.attack is None or not self.is_compromised:
+            return flat
+        import jax
+        import jax.numpy as jnp
+
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self.config.experiment.seed + 7919), round_idx
+        )
+        key = jax.random.fold_in(key, self.node_id)
+        out = self.attack.apply(
+            jnp.asarray(flat)[None, :], jnp.ones((1,)), key, round_idx
+        )
+        return np.asarray(out[0], dtype=np.float32)
+
+    def _collect_states(self, expected: set, deadline: float) -> Dict[int, np.ndarray]:
+        import zmq
+
+        received: Dict[int, np.ndarray] = {}
+        poller = zmq.Poller()
+        poller.register(self._pull, zmq.POLLIN)
+        while expected - set(received) and time.monotonic() < deadline:
+            timeout_ms = max(1, int((deadline - time.monotonic()) * 1000))
+            events = dict(poller.poll(min(timeout_ms, 200)))
+            if self._pull in events:
+                msg_type, sender, payload = decode(self._pull.recv_multipart())
+                if msg_type == MsgType.MODEL_STATE and sender in expected:
+                    received[sender] = unpack_state(payload)
+        missing = expected - set(received)
+        if missing:
+            print(
+                f"[node {self.node_id}] deadline: aggregating with "
+                f"{len(received)}/{len(expected)} neighbors (missing {sorted(missing)})",
+                flush=True,
+            )
+        return received
+
+    def _send_metrics(self, round_idx: int, skipped: bool) -> None:
+        metrics = {"round": round_idx, "node": self.node_id, "skipped": skipped}
+        if not skipped:
+            metrics.update(self.node.evaluate())
+            metrics["stats"] = self.node.get_aggregator_statistics()
+        metrics["compromised"] = self.is_compromised
+        try:
+            self._monitor_push.send_multipart(
+                encode(MsgType.METRICS, self.node_id, pack_obj(metrics))
+            )
+        except Exception as e:  # pragma: no cover
+            print(f"[node {self.node_id}] metrics push failed: {e}", flush=True)
+
+
+def run_single_node(
+    config: Config,
+    node_id: int,
+    t_start: float,
+    run_id: str,
+    host: Optional[str] = None,
+) -> None:
+    """Multi-machine worker entry (reference: cli.py:143-208).  The operator
+    copies run_id/t_start printed by the head node; t_start must be valid on
+    this machine's monotonic clock."""
+    # Strip the TPU plugin env BEFORE importing anything jax-backed —
+    # build_attack pulls in the factories module, which imports jax.
+    _force_cpu_jax()
+    if not 0 <= node_id < config.topology.num_nodes:
+        raise ValueError(
+            f"--node-id {node_id} out of range for "
+            f"topology.num_nodes={config.topology.num_nodes}"
+        )
+    from murmura_tpu.utils.factories import build_attack
+
+    attack = build_attack(config)
+    compromised = sorted(attack.get_compromised_nodes()) if attack else []
+    NodeProcess(
+        config,
+        node_id=node_id,
+        run_id=run_id,
+        t_start=t_start,
+        compromised_ids=compromised,
+        host=host,
+    ).run()

@@ -1,0 +1,144 @@
+"""Single-machine launcher for the ZMQ backend
+(reference: murmura/distributed/runner.py:33-213).
+
+Computes a shared t_start = monotonic() + startup_grace, prints run_id +
+t_start for multi-machine operators, spawns the monitor first and then one
+process per node (picklable module-level entry points), joins the monitor
+for the history, and terminates stragglers.
+"""
+
+import multiprocessing as mp
+import uuid
+from typing import Any, Dict, List
+
+from murmura_tpu.config.schema import Config
+from murmura_tpu.distributed.endpoints import Endpoints
+
+
+def _monitor_main(config: Config, run_id: str, t_start: float,
+                  compromised: List[int], queue) -> None:
+    from murmura_tpu.distributed.monitor import Monitor
+
+    history = Monitor(
+        config, run_id, t_start, compromised_ids=set(compromised)
+    ).run()
+    queue.put(history)
+
+
+def _node_main(config: Config, node_id: int, run_id: str, t_start: float,
+               compromised: List[int]) -> None:
+    from murmura_tpu.distributed.node_process import NodeProcess
+
+    # DMTT configs get the trust-protocol process (reference: runner.py:88-103)
+    if config.dmtt is not None:
+        from murmura_tpu.dmtt.node_process import DMTTNodeProcess
+
+        cls = DMTTNodeProcess
+    else:
+        cls = NodeProcess
+    cls(
+        config,
+        node_id=node_id,
+        run_id=run_id,
+        t_start=t_start,
+        compromised_ids=compromised,
+    ).run()
+
+
+class DistributedRunner:
+    """Launches monitor + N node processes on this machine."""
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def run(self) -> Dict[str, List[Any]]:
+        import importlib.util
+        import os
+
+        from murmura_tpu.utils.factories import build_attack
+
+        if self.config.dmtt is not None:
+            # Fail fast in the parent rather than letting every child die
+            # and the monitor idle until its hard deadline.
+            if importlib.util.find_spec("murmura_tpu.dmtt") is None:
+                raise RuntimeError(
+                    "config.dmtt is set but the DMTT protocol module is not "
+                    "available in this build"
+                )
+
+        # Children must boot clean of the single-tenant TPU plugin: the axon
+        # sitecustomize registers at interpreter start (before any code in
+        # the child runs), so strip the trigger env for the spawn window —
+        # spawn inherits os.environ.  ZMQ-backend local training is a CPU
+        # path by design.  The parent's env is restored afterwards so later
+        # simulation/tpu runs in the same process are unaffected.
+        saved_env = {
+            k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+        }
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+        cfg = self.config
+        attack = build_attack(cfg)
+        compromised = sorted(attack.get_compromised_nodes()) if attack else []
+
+        run_id = uuid.uuid4().hex[:8]
+        endpoints = Endpoints(cfg.distributed, run_id)
+        endpoints.ensure_dirs()
+
+        import time
+
+        t_start = time.monotonic() + cfg.distributed.startup_grace_s
+        print(
+            f"[runner] run_id={run_id} t_start={t_start:.3f} "
+            f"(grace {cfg.distributed.startup_grace_s}s) — pass these to "
+            "`murmura_tpu run-node` on other machines",
+            flush=True,
+        )
+
+        ctx = mp.get_context("spawn")
+        queue = ctx.Queue()
+        monitor = ctx.Process(
+            target=_monitor_main,
+            args=(cfg, run_id, t_start, compromised, queue),
+            daemon=False,
+        )
+        monitor.start()
+
+        nodes = []
+        for node_id in range(cfg.topology.num_nodes):
+            p = ctx.Process(
+                target=_node_main,
+                args=(cfg, node_id, run_id, t_start, compromised),
+                daemon=False,
+            )
+            p.start()
+            nodes.append(p)
+
+        # All children are spawned; restore the parent's env.
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+        history: Dict[str, List[Any]] = {}
+        try:
+            # generous join: rounds * duration + grace + hard-deadline margin
+            budget = (
+                cfg.distributed.startup_grace_s
+                + (cfg.experiment.rounds + 3) * cfg.distributed.round_duration_s
+                + 60.0
+            )
+            monitor.join(timeout=budget)
+            if monitor.is_alive():
+                monitor.terminate()
+            while not queue.empty():
+                history = queue.get_nowait()
+        finally:
+            for p in nodes:
+                p.join(timeout=5.0)
+            for p in nodes:
+                if p.is_alive():
+                    p.terminate()
+        return history

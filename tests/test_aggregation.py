@@ -1,0 +1,367 @@
+"""Closed-form aggregation rule tests (SURVEY.md §4 plan item (a);
+reference semantics: murmura/aggregation/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from murmura_tpu.aggregation import build_aggregator
+from murmura_tpu.aggregation.base import AggContext, pairwise_l2_distances
+
+
+def _ring_adj(n):
+    adj = np.zeros((n, n), dtype=np.float32)
+    for i in range(n):
+        adj[i, (i + 1) % n] = adj[i, (i - 1) % n] = 1.0
+    return jnp.asarray(adj)
+
+
+def _full_adj(n):
+    adj = np.ones((n, n), dtype=np.float32) - np.eye(n, dtype=np.float32)
+    return jnp.asarray(adj)
+
+
+def _ctx(total_rounds=10, **kw):
+    return AggContext(total_rounds=total_rounds, **kw)
+
+
+def _run(agg, own, adj, round_idx=0, bcast=None, ctx=None, state=None):
+    own = jnp.asarray(own, jnp.float32)
+    bcast = own if bcast is None else jnp.asarray(bcast, jnp.float32)
+    state = state if state is not None else {
+        k: jnp.asarray(v) for k, v in agg.init_state(own.shape[0]).items()
+    }
+    return agg.aggregate(own, bcast, adj, jnp.asarray(round_idx, jnp.float32),
+                         state, ctx or _ctx())
+
+
+class TestPairwiseDistances:
+    def test_matches_direct(self):
+        a = np.random.default_rng(0).normal(size=(5, 17)).astype(np.float32)
+        d = np.asarray(pairwise_l2_distances(jnp.asarray(a)))
+        direct = np.linalg.norm(a[:, None] - a[None, :], axis=-1)
+        np.testing.assert_allclose(d, direct, atol=2e-3)
+
+    def test_large_offset_cancellation(self):
+        """Centering keeps small distances accurate under a huge common
+        offset (the late-training regime Krum ranks in)."""
+        rng = np.random.default_rng(1)
+        base = rng.normal(size=(6, 100)).astype(np.float32) * 1e-3
+        shifted = base + 300.0  # norm ~ 3e3, distances ~ 1e-2
+        d = np.asarray(pairwise_l2_distances(jnp.asarray(shifted)))
+        direct = np.linalg.norm(base[:, None] - base[None, :], axis=-1)
+        np.testing.assert_allclose(d, direct, rtol=0.05, atol=1e-4)
+
+
+class TestFedAvg:
+    def test_masked_mean(self):
+        """Ring node averages itself + its two neighbors (fedavg.py:19-42)."""
+        agg = build_aggregator("fedavg", {})
+        own = np.arange(4, dtype=np.float32)[:, None] * np.ones((4, 3))
+        new, _, stats = _run(agg, own, _ring_adj(4))
+        # node 0: mean(own 0, neighbors 1 and 3) = 4/3
+        np.testing.assert_allclose(np.asarray(new)[0], 4.0 / 3.0, atol=1e-6)
+        assert np.asarray(stats["num_neighbors"]).tolist() == [2, 2, 2, 2]
+
+    def test_own_state_vs_broadcast(self):
+        """Aggregating node uses its own true state, neighbors' broadcasts
+        (network.py:108-135)."""
+        agg = build_aggregator("fedavg", {})
+        own = np.zeros((3, 2), dtype=np.float32)
+        bcast = np.ones((3, 2), dtype=np.float32) * 3.0
+        new, _, _ = _run(agg, own, _full_adj(3), bcast=bcast)
+        # each node: (0 + 3 + 3) / 3 = 2
+        np.testing.assert_allclose(np.asarray(new), 2.0, atol=1e-6)
+
+
+class TestKrum:
+    def test_picks_planted_inlier(self):
+        """Cluster of 4 near-identical states + 1 far outlier: Krum must
+        select a cluster member for every honest node (krum.py:64-75)."""
+        rng = np.random.default_rng(0)
+        cluster = rng.normal(size=(1, 8)).astype(np.float32)
+        own = np.repeat(cluster, 5, axis=0) + rng.normal(size=(5, 8)).astype(np.float32) * 0.01
+        own[4] += 100.0  # outlier
+        agg = build_aggregator("krum", {"num_compromised": 1})
+        new, _, stats = _run(agg, own, _full_adj(5))
+        winners = np.asarray(stats["selected_index"])
+        assert all(w != 4 for w in winners[:4])
+        for i in range(4):
+            np.testing.assert_allclose(np.asarray(new)[i], own[winners[i]], atol=1e-5)
+
+    def test_constraint_fallback_to_own(self):
+        """c >= (m-2)/2 -> own state (krum.py:49-52). m=3, c=1: 1 >= 0.5."""
+        own = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+        agg = build_aggregator("krum", {"num_compromised": 1})
+        new, _, stats = _run(agg, own, _ring_adj(3))
+        np.testing.assert_allclose(np.asarray(new), own, atol=1e-6)
+        assert np.asarray(stats["selected_own"]).tolist() == [1.0, 1.0, 1.0]
+
+    def test_selects_own_state_not_broadcast_of_self(self):
+        """Candidate 'self' is the node's true state even when its broadcast
+        differs (krum.py:45)."""
+        own = np.zeros((4, 3), dtype=np.float32)
+        own[1:] += np.random.default_rng(2).normal(size=(3, 3)) * 0.01
+        bcast = own.copy()
+        bcast[0] = 1000.0  # node 0 broadcasts garbage but keeps its true state
+        agg = build_aggregator("krum", {"num_compromised": 0})
+        new, _, stats = _run(agg, own, _full_adj(4), bcast=bcast)
+        # node 0 should still be able to select among the close cluster
+        # (its own true state is close to 1..3)
+        assert np.abs(np.asarray(new)[0]).max() < 1.0
+
+
+class TestBalance:
+    def test_threshold_filters_outlier(self):
+        """Neighbor at distance > gamma*||own|| rejected; close neighbor
+        accepted; output alpha*own + (1-alpha)*mean (balance.py:108-175)."""
+        own = np.ones((3, 4), dtype=np.float32)  # ||own|| = 2
+        bcast = np.stack([
+            np.ones(4), np.ones(4) * 1.1, np.ones(4) * 100.0
+        ]).astype(np.float32)
+        adj = _full_adj(3)
+        agg = build_aggregator("balance", {"gamma": 1.0, "kappa": 0.0,
+                                            "alpha": 0.5, "min_neighbors": 0})
+        new, _, stats = _run(agg, own, adj, bcast=bcast)
+        # node 0: neighbor 1 at dist 0.2 <= 2 accepted; neighbor 2 at ~198 rejected
+        np.testing.assert_allclose(np.asarray(new)[0], 0.5 * 1.0 + 0.5 * 1.1, atol=1e-5)
+        assert np.asarray(stats["acceptance_rate"])[0] == pytest.approx(0.5)
+
+    def test_fallback_accepts_closest(self):
+        """No neighbor passes -> closest accepted when min_neighbors=1
+        (balance.py:133-135)."""
+        own = np.zeros((2, 4), dtype=np.float32)
+        bcast = np.stack([np.zeros(4), np.ones(4) * 50.0]).astype(np.float32)
+        agg = build_aggregator("balance", {"gamma": 0.001, "min_neighbors": 1,
+                                            "alpha": 0.5})
+        new, _, _ = _run(agg, own, _full_adj(2), bcast=bcast)
+        # node 0's only neighbor (dist 100) fails threshold but is the
+        # closest -> accepted: 0.5*0 + 0.5*50
+        np.testing.assert_allclose(np.asarray(new)[0], 25.0, atol=1e-4)
+
+    def test_threshold_tightens_over_rounds(self):
+        agg = build_aggregator("balance", {"gamma": 2.0, "kappa": 1.0})
+        own = np.ones((2, 4), dtype=np.float32)
+        _, _, s0 = _run(agg, own, _full_adj(2), round_idx=0, ctx=_ctx(10))
+        _, _, s9 = _run(agg, own, _full_adj(2), round_idx=9, ctx=_ctx(10))
+        assert np.asarray(s9["threshold"])[0] < np.asarray(s0["threshold"])[0]
+
+
+class TestSketchguard:
+    def test_filters_outlier_via_sketches(self):
+        dim = 64
+        agg = build_aggregator(
+            "sketchguard",
+            {"sketch_size": 32, "gamma": 1.0, "kappa": 0.0, "alpha": 0.5,
+             "min_neighbors": 0},
+            model_dim=dim,
+        )
+        own = np.ones((3, dim), dtype=np.float32)
+        bcast = own.copy()
+        bcast[2] *= 100.0
+        new, state, stats = _run(agg, own, _full_adj(3), bcast=bcast)
+        # honest nodes 0,1 accept each other, reject inflated node 2
+        assert np.asarray(stats["acceptance_rate"])[0] == pytest.approx(0.5)
+        np.testing.assert_allclose(np.asarray(new)[0], 1.0, atol=1e-5)
+        assert np.asarray(stats["compression_ratio"])[0] == pytest.approx(2.0)
+
+    def test_attack_window_boosts_threshold(self):
+        dim = 16
+        agg = build_aggregator(
+            "sketchguard",
+            {"sketch_size": 8, "gamma": 1.0, "kappa": 0.0},
+            model_dim=dim,
+        )
+        own = np.ones((2, dim), dtype=np.float32)
+        # window full of low acceptance -> 1.5x threshold boost
+        state = {
+            "acc_window": jnp.zeros((2, 5), jnp.float32),
+            "window_len": jnp.full((2,), 5, jnp.int32),
+        }
+        _, _, stats_boost = _run(agg, own, _full_adj(2), state=state)
+        fresh = {k: jnp.asarray(v) for k, v in agg.init_state(2).items()}
+        _, _, stats_plain = _run(agg, own, _full_adj(2), state=fresh)
+        assert np.asarray(stats_boost["threshold"])[0] == pytest.approx(
+            1.5 * np.asarray(stats_plain["threshold"])[0]
+        )
+
+    def test_window_state_rolls(self):
+        dim = 16
+        agg = build_aggregator("sketchguard", {"sketch_size": 8}, model_dim=dim)
+        own = np.ones((2, dim), dtype=np.float32)
+        _, state, _ = _run(agg, own, _full_adj(2))
+        assert np.asarray(state["window_len"]).tolist() == [1, 1]
+        assert np.asarray(state["acc_window"])[:, -1].tolist() == [1.0, 1.0]
+
+
+def _probe_ctx(n, num_classes=4, batch=6):
+    """Context whose apply_fn reads logits straight from the flat params:
+    model j's logits on any sample = flat_j[:K].  Lets tests dictate each
+    model's probe loss exactly."""
+    probe_x = jnp.zeros((n, batch, 2), jnp.float32)
+    probe_y = jnp.zeros((n, batch), jnp.int32)  # true class always 0
+    probe_mask = jnp.ones((n, batch), jnp.float32)
+
+    def apply_fn(params, x, key, train):
+        return jnp.tile(params[:num_classes][None, :], (x.shape[0], 1))
+
+    return AggContext(
+        apply_fn=apply_fn,
+        unravel=lambda flat: flat,
+        probe_x=probe_x,
+        probe_y=probe_y,
+        probe_mask=probe_mask,
+        num_classes=num_classes,
+        total_rounds=10,
+    )
+
+
+class TestUBAR:
+    def test_two_stage_selection(self):
+        """Stage 1 shortlists closest rho*deg; stage 2 keeps loss <= own
+        (ubar.py:114-202)."""
+        n, k = 4, 4
+        ctx = _probe_ctx(n, num_classes=k)
+        # flat[:4] are the logits; class 0 is the target.
+        good = np.array([5.0, 0.0, 0.0, 0.0] + [0.0] * 4, dtype=np.float32)
+        bad = np.array([-5.0, 5.0, 0.0, 0.0] + [0.0] * 4, dtype=np.float32)
+        own = np.stack([good, good * 0.9, bad, good * 1.1]).astype(np.float32)
+        agg = build_aggregator("ubar", {"rho": 1.0, "alpha": 0.5})
+        new, _, stats = _run(agg, own, _full_adj(n), ctx=ctx)
+        # node 0: neighbor 3 (logits 1.1x -> lower CE loss than own) passes
+        # stage 2; neighbor 1 (0.9x -> higher loss) and neighbor 2 (bad) are
+        # rejected (accept iff loss <= own loss, ubar.py:191).
+        expected = 0.5 * own[0] + 0.5 * own[3]
+        np.testing.assert_allclose(np.asarray(new)[0], expected, atol=1e-5)
+
+    def test_stage2_fallback_best_loss(self):
+        """None pass stage 2 -> best-loss shortlisted accepted (ubar.py:195-197)."""
+        n, k = 3, 4
+        ctx = _probe_ctx(n, num_classes=k)
+        best = np.array([9.0, 0, 0, 0, 0, 0, 0, 0], dtype=np.float32)
+        mid = np.array([4.0, 0, 0, 0, 0, 0, 0, 0], dtype=np.float32)
+        worst = np.array([0.0, 5.0, 0, 0, 0, 0, 0, 0], dtype=np.float32)
+        own = np.stack([best, mid, worst]).astype(np.float32)
+        agg = build_aggregator("ubar", {"rho": 1.0, "alpha": 0.5})
+        new, _, _ = _run(agg, own, _full_adj(n), ctx=ctx)
+        # node 0 has the lowest loss; no neighbor beats it -> fallback to
+        # the best neighbor (node 1): 0.5*best + 0.5*mid
+        np.testing.assert_allclose(
+            np.asarray(new)[0], 0.5 * best + 0.5 * mid, atol=1e-5
+        )
+
+    def test_stage1_rank_count(self):
+        n, k = 5, 4
+        ctx = _probe_ctx(n, num_classes=k)
+        own = np.random.default_rng(3).normal(size=(n, 8)).astype(np.float32)
+        agg = build_aggregator("ubar", {"rho": 0.5, "min_neighbors": 1})
+        _, _, stats = _run(agg, own, _full_adj(n), ctx=ctx)
+        # deg = 4, rho*deg = 2 shortlisted of 4 -> stage1 rate 0.5
+        np.testing.assert_allclose(np.asarray(stats["stage1_acceptance_rate"]), 0.5)
+
+
+def _evidential_ctx(n, num_classes=4, batch=6):
+    """apply_fn yields alphas = softplus(flat[:K]) + 1 so tests control
+    evidence/vacuity/accuracy directly."""
+    probe_x = jnp.zeros((n, batch, 2), jnp.float32)
+    probe_y = jnp.zeros((n, batch), jnp.int32)
+    probe_mask = jnp.ones((n, batch), jnp.float32)
+
+    def apply_fn(params, x, key, train):
+        alpha = jax.nn.softplus(params[:num_classes]) + 1.0
+        return jnp.tile(alpha[None, :], (x.shape[0], 1))
+
+    return AggContext(
+        apply_fn=apply_fn,
+        unravel=lambda flat: flat,
+        probe_x=probe_x,
+        probe_y=probe_y,
+        probe_mask=probe_mask,
+        evidential=True,
+        num_classes=num_classes,
+        total_rounds=10,
+    )
+
+
+class TestEvidentialTrust:
+    def test_high_vacuity_neighbor_filtered(self):
+        """Low-evidence (vacuous) neighbor scores below threshold and is
+        excluded; confident accurate neighbor dominates
+        (evidential_trust.py:289-305)."""
+        n, k = 3, 4
+        ctx = _evidential_ctx(n, num_classes=k)
+        confident = np.array([20.0, -20, -20, -20] + [0.0] * 4, np.float32)
+        vacuous = np.array([-20.0, -20, -20, -20] + [0.0] * 4, np.float32)
+        own = np.stack([confident, confident * 1.01, vacuous]).astype(np.float32)
+        agg = build_aggregator(
+            "evidential_trust",
+            {"trust_threshold": 0.3, "use_tightening_threshold": False,
+             "use_adaptive_trust": False, "self_weight": 0.5,
+             "strength_guard": False},
+        )
+        new, _, stats = _run(agg, own, _full_adj(n), ctx=ctx)
+        # node 0 accepts only node 1 -> 0.5*own + 0.5*neighbor1
+        np.testing.assert_allclose(
+            np.asarray(new)[0], 0.5 * own[0] + 0.5 * own[1], atol=1e-4
+        )
+        assert np.asarray(stats["acceptance_rate"])[0] == pytest.approx(0.5)
+
+    def test_none_accepted_returns_own(self):
+        n, k = 2, 4
+        ctx = _evidential_ctx(n, num_classes=k)
+        vacuous = np.array([-20.0, -20, -20, -20, 0, 0, 0, 0], np.float32)
+        own = np.stack([vacuous, vacuous * 1.1]).astype(np.float32)
+        agg = build_aggregator(
+            "evidential_trust",
+            {"trust_threshold": 0.9, "use_tightening_threshold": False,
+             "strength_guard": False},
+        )
+        new, _, _ = _run(agg, own, _full_adj(n), ctx=ctx)
+        np.testing.assert_allclose(np.asarray(new), own, atol=1e-5)
+
+    def test_ema_smoothing_state(self):
+        """trust_t = momentum*new + (1-momentum)*old after first observation
+        (evidential_trust.py:318-342)."""
+        n, k = 2, 4
+        ctx = _evidential_ctx(n, num_classes=k)
+        confident = np.array([20.0, -20, -20, -20, 0, 0, 0, 0], np.float32)
+        own = np.stack([confident, confident]).astype(np.float32)
+        agg = build_aggregator(
+            "evidential_trust",
+            {"trust_momentum": 0.7, "use_tightening_threshold": False,
+             "strength_guard": False},
+        )
+        _, state1, s1 = _run(agg, own, _full_adj(n), ctx=ctx)
+        t1 = np.asarray(state1["smoothed_trust"])[0, 1]
+        # second round, same inputs: smoothed = 0.7*t + 0.3*t = t (fixed point)
+        _, state2, _ = _run(agg, own, _full_adj(n), state=state1, ctx=_evidential_ctx(n))
+        t2 = np.asarray(state2["smoothed_trust"])[0, 1]
+        assert t2 == pytest.approx(t1, abs=1e-5)
+        assert np.asarray(state1["trust_seen"])[0, 1] == 1.0
+
+    def test_strength_guard_rejects_inflated(self):
+        """Neighbor with evidence >> median neighborhood strength gets zero
+        trust (documented robustness extension)."""
+        n, k = 4, 4
+        ctx = _evidential_ctx(n, num_classes=k)
+        normal = np.array([2.0, 1.0, 1.0, 1.0, 0, 0, 0, 0], np.float32)
+        inflated = np.array([5000.0, 5000, 5000, 5000, 0, 0, 0, 0], np.float32)
+        own = np.stack([normal, normal * 1.01, normal * 0.99, inflated]).astype(
+            np.float32
+        )
+        agg = build_aggregator(
+            "evidential_trust",
+            {"trust_threshold": 0.05, "use_tightening_threshold": False,
+             "use_adaptive_trust": False, "strength_guard": True,
+             "strength_guard_factor": 10.0},
+        )
+        _, _, stats = _run(agg, own, _full_adj(n), ctx=ctx)
+        # honest node 0: neighbors 1,2 accepted, 3 (inflated) rejected
+        assert np.asarray(stats["acceptance_rate"])[0] == pytest.approx(2.0 / 3.0)
+
+
+class TestUnknownAlgorithm:
+    def test_raises(self):
+        with pytest.raises(ValueError):
+            build_aggregator("median_of_means", {})

@@ -1,0 +1,105 @@
+"""Attack subsystem tests (reference semantics: murmura/attacks/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from murmura_tpu.attacks import (
+    false_claims,
+    make_directed_deviation_attack,
+    make_gaussian_attack,
+    make_topology_liar_attack,
+    select_compromised,
+)
+
+
+class TestSelection:
+    def test_count_rule(self):
+        """max(1, floor(pct*n)) when pct > 0 (gaussian.py:36-44)."""
+        assert select_compromised(10, 0.2, seed=1).sum() == 2
+        assert select_compromised(10, 0.05, seed=1).sum() == 1  # ceil-to-1
+        assert select_compromised(10, 0.0, seed=1).sum() == 0
+
+    def test_deterministic(self):
+        a = select_compromised(20, 0.3, seed=7)
+        b = select_compromised(20, 0.3, seed=7)
+        assert np.array_equal(a, b)
+        c = select_compromised(20, 0.3, seed=8)
+        assert not np.array_equal(a, c)
+
+
+class TestGaussian:
+    def test_noise_only_on_compromised(self):
+        atk = make_gaussian_attack(4, 0.5, noise_std=1.0, seed=0)
+        flat = jnp.zeros((4, 16))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        out = np.asarray(atk.apply(flat, comp, jax.random.PRNGKey(0), 0))
+        for i in range(4):
+            if atk.compromised[i]:
+                assert np.abs(out[i]).max() > 0
+            else:
+                assert np.abs(out[i]).max() == 0
+
+    def test_noise_scale(self):
+        atk = make_gaussian_attack(2, 1.0, noise_std=10.0, seed=0)
+        flat = jnp.zeros((2, 10000))
+        comp = jnp.ones(2)
+        out = np.asarray(atk.apply(flat, comp, jax.random.PRNGKey(1), 0))
+        assert out.std() == pytest.approx(10.0, rel=0.05)
+
+
+class TestDirectedDeviation:
+    def test_lambda_scaling(self):
+        atk = make_directed_deviation_attack(3, 0.34, lambda_param=-5.0, seed=0)
+        flat = jnp.ones((3, 8))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        out = np.asarray(atk.apply(flat, comp, None, 0))
+        for i in range(3):
+            expected = -5.0 if atk.compromised[i] else 1.0
+            np.testing.assert_allclose(out[i], expected)
+
+
+class TestTopologyLiar:
+    def test_false_claims_add_coalition(self):
+        """Liar's claim = true neighbors ∪ other Byzantine nodes
+        (topology_liar.py:78-102)."""
+        true_adj = jnp.asarray(np.array([
+            [0, 1, 0, 0],
+            [1, 0, 1, 0],
+            [0, 1, 0, 1],
+            [0, 0, 1, 0],
+        ], dtype=np.float32))
+        comp = jnp.asarray(np.array([1, 0, 0, 1], dtype=np.float32))
+        claims = np.asarray(false_claims(true_adj, comp))
+        # honest rows unchanged
+        np.testing.assert_array_equal(claims[1], [1, 0, 1, 0])
+        np.testing.assert_array_equal(claims[2], [0, 1, 0, 1])
+        # liar 0 adds fellow-Byzantine 3; liar 3 adds 0
+        np.testing.assert_array_equal(claims[0], [0, 1, 0, 1])
+        np.testing.assert_array_equal(claims[3], [1, 0, 1, 0])
+
+    def test_pure_liar_no_model_poisoning(self):
+        atk = make_topology_liar_attack(4, 0.5, seed=0)
+        flat = jnp.ones((4, 8))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        out = np.asarray(atk.apply(flat, comp, jax.random.PRNGKey(0), 0))
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_wrapped_model_attack_shares_compromised_set(self):
+        inner = make_gaussian_attack(4, 0.5, noise_std=1.0, seed=99)
+        atk = make_topology_liar_attack(4, 0.5, seed=0, model_attack=inner)
+        flat = jnp.zeros((4, 8))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        out = np.asarray(atk.apply(flat, comp, jax.random.PRNGKey(0), 0))
+        for i in range(4):
+            assert (np.abs(out[i]).max() > 0) == bool(atk.compromised[i])
+
+
+class TestAttackProtocol:
+    def test_is_compromised_and_set(self):
+        atk = make_gaussian_attack(10, 0.2, seed=42)
+        nodes = atk.get_compromised_nodes()
+        assert len(nodes) == 2
+        for i in range(10):
+            assert atk.is_compromised(i) == (i in nodes)

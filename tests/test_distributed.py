@@ -1,0 +1,164 @@
+"""ZMQ distributed backend tests (reference: murmura/distributed/).
+
+The full-stack test spawns real node processes over IPC sockets on this
+machine (SURVEY.md §4: "multi-node without a cluster") — generous round
+windows because all processes share one core in CI.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from murmura_tpu.config import Config
+from murmura_tpu.distributed.endpoints import Endpoints
+from murmura_tpu.distributed.messaging import (
+    MsgType,
+    decode,
+    encode,
+    pack_obj,
+    pack_state,
+    unpack_obj,
+    unpack_state,
+)
+
+
+class TestMessaging:
+    def test_state_roundtrip(self):
+        flat = np.random.default_rng(0).normal(size=1000).astype(np.float32)
+        header, payload = encode(MsgType.MODEL_STATE, 3, pack_state(flat))
+        msg_type, sender, body = decode([header, payload])
+        assert msg_type == MsgType.MODEL_STATE and sender == 3
+        np.testing.assert_array_equal(unpack_state(body), flat)
+
+    def test_obj_roundtrip(self):
+        metrics = {"round": 2, "accuracy": 0.93, "stats": {"a": 1.0}}
+        header, payload = encode(MsgType.METRICS, 0, pack_obj(metrics))
+        msg_type, sender, body = decode([header, payload])
+        assert msg_type == MsgType.METRICS
+        assert unpack_obj(body) == metrics
+
+    def test_decode_rejects_bad_frame_count(self):
+        with pytest.raises(ValueError):
+            decode([b"xxx"])
+
+
+class TestEndpoints:
+    def _cfg(self, **kw):
+        from murmura_tpu.config.schema import DistributedConfig
+
+        return DistributedConfig(**kw)
+
+    def test_ipc_per_run_dirs(self, tmp_path):
+        ep = Endpoints(self._cfg(transport="ipc", ipc_dir=str(tmp_path)), "runA")
+        assert ep.node_bind(2) == f"ipc://{tmp_path}/runA/node_2"
+        assert ep.node_bind(2) == ep.node_connect(2)
+        assert "monitor" in ep.monitor_bind()
+
+    def test_tcp_ports_and_host_overrides(self):
+        ep = Endpoints(
+            self._cfg(transport="tcp", base_port=6000, host="10.0.0.1",
+                      node_hosts={1: "10.0.0.9"}),
+            "runB",
+        )
+        assert ep.node_bind(0) == "tcp://0.0.0.0:6000"
+        assert ep.node_connect(0) == "tcp://10.0.0.1:6000"
+        assert ep.node_connect(1) == "tcp://10.0.0.9:6001"
+
+
+class TestLocalNode:
+    def test_train_eval_aggregate(self):
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.models.mlp import make_mlp
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 8)).astype(np.float32)
+        y = rng.integers(0, 3, size=64).astype(np.int32)
+        node = LocalNode(
+            0, make_mlp(8, (16,), 3), build_aggregator("fedavg", {}),
+            x, y, max_neighbors=2, batch_size=16, lr=0.1, seed=0,
+        )
+        before = node.evaluate()
+        node.local_train(0)
+        flat = node.get_flat_state()
+        # fedavg with one neighbor at the same state leaves params unchanged
+        node.aggregate_with_neighbors({1: flat.copy()}, 0)
+        np.testing.assert_allclose(node.get_flat_state(), flat, atol=1e-5)
+        after = node.evaluate()
+        assert np.isfinite(after["loss"])
+
+    def test_partial_aggregation_with_subset(self):
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.models.mlp import make_mlp
+
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = rng.integers(0, 2, size=32).astype(np.int32)
+        node = LocalNode(
+            0, make_mlp(4, (8,), 2), build_aggregator("fedavg", {}),
+            x, y, max_neighbors=3, batch_size=8, seed=1,
+        )
+        own = node.get_flat_state()
+        # only 1 of 3 possible neighbors arrived (deadline semantics)
+        node.aggregate_with_neighbors({2: own + 2.0}, 0)
+        np.testing.assert_allclose(node.get_flat_state(), own + 1.0, atol=1e-4)
+
+    def test_edge_state_projection_evidential(self):
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.models.mlp import make_mlp
+
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(48, 6)).astype(np.float32)
+        y = rng.integers(0, 3, size=48).astype(np.int32)
+        node = LocalNode(
+            0,
+            make_mlp(6, (8,), 3, evidential=True),
+            build_aggregator("evidential_trust", {"max_eval_samples": 16}),
+            x, y, max_neighbors=2, batch_size=8, seed=2, probe_size=16,
+        )
+        own = node.get_flat_state()
+        node.aggregate_with_neighbors({5: own * 1.01, 9: own * 0.99}, 0)
+        # EMA trust recorded per neighbor id
+        assert set(node._edge_state["smoothed_trust"]) == {5, 9}
+        assert set(node._edge_state["trust_seen"]) == {5, 9}
+
+
+@pytest.mark.slow
+class TestFullStack:
+    def test_two_round_ipc_run(self, tmp_path):
+        """Full multi-process run over IPC sockets with learning progress."""
+        from murmura_tpu.distributed.runner import DistributedRunner
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "dist-test", "seed": 42, "rounds": 2},
+                "topology": {"type": "ring", "num_nodes": 4},
+                "aggregation": {"algorithm": "fedavg"},
+                "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+                "data": {
+                    "adapter": "synthetic",
+                    "params": {"num_samples": 320, "input_dim": 16,
+                                "num_classes": 4},
+                },
+                "model": {
+                    "factory": "mlp",
+                    "params": {"input_dim": 16, "num_classes": 4,
+                                "hidden_dims": [16]},
+                },
+                "backend": "distributed",
+                "distributed": {
+                    "transport": "ipc",
+                    "ipc_dir": str(tmp_path),
+                    "round_duration_s": 25.0,
+                    "startup_grace_s": 30.0,
+                },
+            }
+        )
+        t0 = time.monotonic()
+        history = DistributedRunner(cfg).run()
+        assert history["round"] == [1, 2], history
+        assert history["mean_accuracy"][-1] > 0.3
+        assert time.monotonic() - t0 < 200

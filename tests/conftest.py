@@ -1,24 +1,32 @@
 """Test harness config.
 
-Force an 8-virtual-device CPU platform BEFORE jax initializes so
-topology-masked collectives and the tpu backend's mesh sharding run without
-real TPU hardware (SURVEY.md §4 test plan item (c)).
-
-Note: tests must run in a fresh interpreter (pytest does this) — the env
-mutations below only take effect if jax has not yet been imported.  Clearing
-``PALLAS_AXON_POOL_IPS`` keeps test processes off the single-tenant TPU
-tunnel entirely.
+Force an 8-virtual-device CPU platform before any jax *backend* initializes
+so topology-masked collectives and the tpu backend's mesh sharding run
+without real TPU hardware (SURVEY.md §4 test plan item (c)).  Keeping the
+suite off the TPU also matters operationally: the chip is single-tenant and
+a killed test process can wedge the tunnel.
 """
 
 import os
 
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)  # skip axon TPU registration
-os.environ["JAX_PLATFORMS"] = "cpu"
+# The environment may register a TPU PJRT plugin via sitecustomize at
+# interpreter startup, importing jax before this file runs — so mutating
+# JAX_PLATFORMS here is too late.  jax.config.update works as long as no
+# backend has been initialized yet, which pytest guarantees (fresh
+# interpreter, conftest imported before any test module).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+assert jax.default_backend() == "cpu", (
+    "a non-CPU jax backend initialized before tests/conftest.py could pin "
+    "the platform — the suite must not run against the real TPU"
+)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

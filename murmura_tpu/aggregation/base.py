@@ -43,6 +43,10 @@ class AggContext:
         evidential: whether apply_fn outputs Dirichlet alphas.
         num_classes: output arity (for losses).
         total_rounds: T for threshold schedules.
+        probe_cross: optional precomputed [N, N] cross-eval metric dict
+            (probe.combined_probe_metric output) — set when another consumer
+            in the same round step (DMTT) already paid for the N x N forward
+            sweep, so probe-based rules reuse instead of recompute.
     """
 
     apply_fn: Callable = None
@@ -53,6 +57,7 @@ class AggContext:
     evidential: bool = False
     num_classes: int = 0
     total_rounds: int = 1
+    probe_cross: Optional[Dict[str, jnp.ndarray]] = None
 
 
 @dataclass(frozen=True)

@@ -65,8 +65,13 @@ def make_evidential_trust(
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         adj_b = adj.astype(bool)
 
-        # Phase 1: cross-evaluate all broadcast models on all nodes' probe data.
-        metrics = pairwise_probe_eval(bcast, ctx, evidential_trust_metric)
+        # Phase 1: cross-evaluate all broadcast models on all nodes' probe
+        # data — reusing the round's shared cross-eval when DMTT already ran
+        # it with the evidential metric fields included.
+        if ctx.probe_cross is not None and "entropy" in ctx.probe_cross:
+            metrics = ctx.probe_cross
+        else:
+            metrics = pairwise_probe_eval(bcast, ctx, evidential_trust_metric)
         vacuity = metrics["vacuity"]  # [N_i, N_j]
         accuracy = metrics["accuracy"]
 

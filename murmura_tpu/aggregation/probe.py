@@ -54,6 +54,31 @@ def ce_loss_metric(outputs, y, mask):
     return {"loss": (nll * mask).sum() / jnp.maximum(mask.sum(), 1.0)}
 
 
+def accuracy_vacuity_metric(outputs, y, mask):
+    """Masked accuracy + zero vacuity for softmax models — the DMTT model
+    score path when the model has no evidential head
+    (murmura/dmtt/node_process.py:333-363: u_bar stays 0 for softmax)."""
+    denom = jnp.maximum(mask.sum(), 1.0)
+    acc = ((jnp.argmax(outputs, -1) == y).astype(jnp.float32) * mask).sum() / denom
+    return {"accuracy": acc, "vacuity": jnp.zeros(())}
+
+
+def combined_probe_metric(evidential: bool):
+    """One metric covering every probe consumer in a round, so the N x N
+    cross-eval is computed once and shared: DMTT model scoring needs
+    accuracy/vacuity, UBAR stage 2 needs the CE loss, evidential trust needs
+    vacuity/entropy/strength.  Forward passes dominate the cross-eval cost;
+    emitting extra reductions per pass is free by comparison."""
+    base = evidential_trust_metric if evidential else accuracy_vacuity_metric
+
+    def metric(outputs, y, mask):
+        out = base(outputs, y, mask)
+        out.update(ce_loss_metric(outputs, y, mask))
+        return out
+
+    return metric
+
+
 def evidential_trust_metric(outputs, y, mask):
     """Masked accuracy + mean vacuity of Dirichlet outputs
     (evidential_trust.py:249-287)."""

@@ -42,8 +42,13 @@ def make_ubar(
         num_select = jnp.maximum(min_neighbors, (rho * degree).astype(jnp.int32))
         shortlist = rank_mask(dist, adj_b, num_select)
 
-        # Stage 2: loss probe on one local batch (ubar.py:152-202).
-        losses = pairwise_probe_eval(bcast, ctx, ce_loss_metric)["loss"]  # [N_i, N_j]
+        # Stage 2: loss probe on one local batch (ubar.py:152-202).  Reuse
+        # the round's shared cross-eval when another consumer (DMTT) already
+        # ran the N x N forward sweep.
+        if ctx.probe_cross is not None and "loss" in ctx.probe_cross:
+            losses = ctx.probe_cross["loss"]  # [N_i, N_j]
+        else:
+            losses = pairwise_probe_eval(bcast, ctx, ce_loss_metric)["loss"]
         own_loss = self_probe_metrics(own, ctx, ce_loss_metric)["loss"]  # [N]
         passed = shortlist & (losses <= own_loss[:, None])
 

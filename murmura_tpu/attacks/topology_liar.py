@@ -37,17 +37,12 @@ def make_topology_liar_attack(
     model_attack: Optional[Attack] = None,
 ) -> Attack:
     compromised = select_compromised(num_nodes, attack_percentage, seed)
-    if model_attack is not None:
-        # Share the liar's compromised set so poisoning and lying coincide.
-        model_attack = Attack(
-            name=model_attack.name,
-            compromised=compromised,
-            apply=model_attack.apply,
-        )
 
     def apply(flat, compromised_mask, key, round_idx):
         """Model poisoning is delegated to the wrapped inner attack
-        (topology_liar.py:57-72); pure liars broadcast honest states."""
+        (topology_liar.py:57-72); pure liars broadcast honest states.
+        The round step passes the liar's compromised mask, so poisoning and
+        lying coincide regardless of the inner attack's own selection."""
         if model_attack is None:
             return flat
         return model_attack.apply(flat, compromised_mask, key, round_idx)

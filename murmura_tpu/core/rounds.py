@@ -24,6 +24,7 @@ Under ``backend: simulation`` this runs vmapped on one device; under
 a mesh so the gather rides ICI (see parallel/mesh.py).
 """
 
+import dataclasses
 import functools
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
@@ -33,8 +34,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from murmura_tpu.aggregation.base import AggContext, AggregatorDef
+from murmura_tpu.aggregation.probe import combined_probe_metric, pairwise_probe_eval
 from murmura_tpu.attacks.base import Attack
 from murmura_tpu.data.base import FederatedArrays
+from murmura_tpu.dmtt.protocol import (
+    DMTTParams,
+    dmtt_round_update,
+    init_dmtt_state,
+)
 from murmura_tpu.models.core import Model
 from murmura_tpu.ops.flatten import make_flatteners
 from murmura_tpu.ops.losses import (
@@ -42,6 +49,9 @@ from murmura_tpu.ops.losses import (
     masked_cross_entropy,
     uncertainty_metrics,
 )
+
+
+DMTT_STATE_KEYS = ("dmtt_c_hat", "dmtt_alpha", "dmtt_beta", "dmtt_collab")
 
 
 @dataclass(frozen=True)
@@ -76,6 +86,7 @@ def build_round_program(
     annealing_rounds: Optional[int] = None,
     lambda_weight: float = 0.1,
     eval_chunk: int = 1024,
+    dmtt: Optional[DMTTParams] = None,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -85,6 +96,10 @@ def build_round_program(
             max_eval_samples — evidential_trust.py:62-63).
         annealing_rounds: evidential-loss KL annealing horizon (reference
             wiring: rounds // 2, factories.py:114).
+        dmtt: when set, the trust protocol runs inside the round step —
+            TOPO_CLAIM verification, Beta trust, TopB collaborator selection
+            gate the exchange mask handed to the aggregator
+            (murmura/dmtt/node_process.py:150-250).
     """
     n = data.num_nodes
     num_classes = data.num_classes or model.num_classes
@@ -242,6 +257,7 @@ def build_round_program(
     )
 
     attack_apply = attack.apply if attack is not None else None
+    claims_fn = attack.claims_fn if attack is not None else None
 
     def round_step(params, agg_state, key, adj, compromised, round_idx, d):
         train_key, attack_key = jax.random.split(key)
@@ -257,7 +273,6 @@ def build_round_program(
         else:
             bcast = own_flat
 
-        # 3. adjacency-masked aggregation (network.py:121-139)
         step_ctx = AggContext(
             apply_fn=ctx.apply_fn,
             unravel=ctx.unravel,
@@ -268,19 +283,53 @@ def build_round_program(
             num_classes=ctx.num_classes,
             total_rounds=ctx.total_rounds,
         )
-        new_flat, agg_state, agg_stats = agg.aggregate(
-            own_flat, bcast, adj, round_idx, agg_state, step_ctx
+
+        # 2b. DMTT: claim exchange + trust update gate the exchange mask
+        # (murmura/dmtt/node_process.py:187-241).  The N x N probe cross-eval
+        # is computed once here and shared with probe-based aggregation rules
+        # via ctx.probe_cross.
+        dmtt_stats = {}
+        if dmtt is not None:
+            if claims_fn is not None:
+                claims = claims_fn(adj, compromised)
+            else:
+                claims = adj
+            cross = pairwise_probe_eval(
+                bcast, step_ctx, combined_probe_metric(evidential)
+            )
+            exchange, dmtt_state, dmtt_stats = dmtt_round_update(
+                {k: agg_state[k] for k in DMTT_STATE_KEYS},
+                adj,
+                claims,
+                cross["accuracy"],
+                cross["vacuity"],
+                dmtt,
+            )
+            agg_state = {**agg_state, **dmtt_state}
+            adj = exchange
+            step_ctx = dataclasses.replace(step_ctx, probe_cross=cross)
+
+        # 3. adjacency-masked aggregation (network.py:121-139)
+        rule_state = {k: v for k, v in agg_state.items() if k not in DMTT_STATE_KEYS}
+        new_flat, rule_state, agg_stats = agg.aggregate(
+            own_flat, bcast, adj, round_idx, rule_state, step_ctx
         )
+        agg_state = {**agg_state, **rule_state}
         params = jax.vmap(unravel)(new_flat)
 
         # 4. evaluation (network.py:141-199)
         metrics = evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
         metrics.update({f"agg_{k}": v for k, v in agg_stats.items()})
+        metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
         return params, agg_state, metrics
 
     init_agg_state = {
         k: np.asarray(v) for k, v in agg.init_state(n).items()
     }
+    if dmtt is not None:
+        init_agg_state.update(
+            {k: np.asarray(v) for k, v in init_dmtt_state(n).items()}
+        )
 
     return RoundProgram(
         step=round_step,

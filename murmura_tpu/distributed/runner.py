@@ -60,7 +60,7 @@ class DistributedRunner:
         if self.config.dmtt is not None:
             # Fail fast in the parent rather than letting every child die
             # and the monitor idle until its hard deadline.
-            if importlib.util.find_spec("murmura_tpu.dmtt") is None:
+            if importlib.util.find_spec("murmura_tpu.dmtt.node_process") is None:
                 raise RuntimeError(
                     "config.dmtt is set but the DMTT protocol module is not "
                     "available in this build"

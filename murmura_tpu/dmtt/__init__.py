@@ -1,0 +1,26 @@
+"""DMTT — dynamic-mobility topology trust protocol.
+
+TPU-native redesign of the reference's per-process trust bookkeeping
+(reference: murmura/dmtt/state.py:22-159, murmura/dmtt/node_process.py:53-406).
+All per-(observer, subject) quantities are [N, N] arrays carried through the
+jitted round step; claim exchange, verification, Beta-evidence updates, and
+TopB collaborator selection are pure array transforms.
+"""
+
+from murmura_tpu.dmtt.protocol import (
+    DMTTParams,
+    collab_score,
+    dmtt_round_update,
+    init_dmtt_state,
+    model_score,
+    topo_trust,
+)
+
+__all__ = [
+    "DMTTParams",
+    "collab_score",
+    "dmtt_round_update",
+    "init_dmtt_state",
+    "model_score",
+    "topo_trust",
+]

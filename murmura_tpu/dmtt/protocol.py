@@ -1,0 +1,202 @@
+"""DMTT trust protocol as pure array transforms.
+
+The reference tracks, per node i, dicts keyed by neighbor j: link-reliability
+EMA ĉ_ij, Beta-evidence (α_ij, β_ij), and derives topo trust, model score and
+a collaboration score used for TopB collaborator selection
+(murmura/dmtt/state.py:22-159).  Claims are verified against the locally
+recomputed deterministic mobility graph G^t
+(murmura/dmtt/node_process.py:369-395).
+
+Here every directed-edge quantity is one [N, N] array (entry [i, j] = what
+observer i believes about subject j) and the whole 11-step DMTT round
+(murmura/dmtt/node_process.py:150-250) reduces to a handful of masked array
+updates that trace into the jitted round step.  The "send to collaborators /
+collect from expected" ZMQ exchange becomes a single effective-exchange mask
+E = C ∧ Cᵀ over the gathered state tensor: node j's broadcast reaches node i
+iff j sends to i (i ∈ C_j) and i expects it (j ∈ C_i) — the same acceptance
+rule the reference applies when it drops unexpected senders
+(murmura/dmtt/node_process.py:288-289).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+AggState = Dict[str, jnp.ndarray]
+
+
+@dataclass(frozen=True)
+class DMTTParams:
+    """Static DMTT hyperparameters (reference: murmura/config/schema.py:114-139)."""
+
+    budget_B: int = 5
+    rho: float = 0.1
+    lambda_forget: float = 0.9
+    w_d: float = 1.0
+    w_c: float = 0.5
+    w_x: float = 1.0
+    tau_U: float = 0.3
+    eta: float = 5.0
+    w_a: float = 0.7
+    tau_u: float = 0.5
+    lambda1: float = 0.4
+    lambda2: float = 0.3
+    lambda3: float = 0.2
+    lambda4: float = 0.1
+
+
+def init_dmtt_state(num_nodes: int) -> AggState:
+    """Initial trust state (reference: murmura/dmtt/state.py:42-47).
+
+    ``dmtt_collab`` starts all-zero as the no-selection-yet sentinel
+    (the reference's ``self._collaborators is None``,
+    murmura/dmtt/node_process.py:111-118): while it is all-zero the round
+    uses the G^t adjacency directly, and the first TopB selection writes the
+    real mask.  Keying on the state itself (not the round index) keeps a
+    resumed ``train()`` call from discarding the learned selection.
+    """
+    n = num_nodes
+    return {
+        "dmtt_c_hat": jnp.full((n, n), 0.5, jnp.float32),
+        "dmtt_alpha": jnp.ones((n, n), jnp.float32),
+        "dmtt_beta": jnp.ones((n, n), jnp.float32),
+        "dmtt_collab": jnp.zeros((n, n), jnp.float32),
+    }
+
+
+def topo_trust(
+    alpha: jnp.ndarray, beta: jnp.ndarray, p: DMTTParams
+) -> jnp.ndarray:
+    """T^topo = R · exp(-η · max(0, U - τ_U)) with R the Beta posterior mean
+    and U the posterior std (reference: murmura/dmtt/state.py:82-94)."""
+    s = alpha + beta
+    r = alpha / s
+    u = jnp.sqrt(jnp.maximum(0.0, alpha * beta / (s * s * (s + 1.0))))
+    return r * jnp.exp(-p.eta * jnp.maximum(0.0, u - p.tau_U))
+
+
+def model_score(
+    accuracy: jnp.ndarray, u_bar: jnp.ndarray, p: DMTTParams
+) -> jnp.ndarray:
+    """s^model = (1-ū)(w_a·a + (1-w_a)), penalized ×exp(-(ū-τ_u)) above the
+    uncertainty threshold, floored at 0 (reference: murmura/dmtt/state.py:100-110)."""
+    s_base = (1.0 - u_bar) * (p.w_a * accuracy + (1.0 - p.w_a))
+    s_base = jnp.where(
+        u_bar > p.tau_u, s_base * jnp.exp(-(u_bar - p.tau_u)), s_base
+    )
+    return jnp.maximum(0.0, s_base)
+
+
+def collab_score(
+    s_model: jnp.ndarray,
+    t_topo: jnp.ndarray,
+    c_hat: jnp.ndarray,
+    p: DMTTParams,
+    c_comm: float = 0.0,
+) -> jnp.ndarray:
+    """q = λ1·s_model + λ2·T^topo + λ3·ĉ - λ4·c_comm
+    (reference: murmura/dmtt/state.py:112-122)."""
+    return (
+        p.lambda1 * s_model
+        + p.lambda2 * t_topo
+        + p.lambda3 * c_hat
+        - p.lambda4 * c_comm
+    )
+
+
+def _top_b_mask(q: jnp.ndarray, valid: jnp.ndarray, b: int) -> jnp.ndarray:
+    """Row-wise B-hot mask of the highest-q valid candidates
+    (reference: murmura/dmtt/state.py:128-142).  Rows with fewer than B valid
+    candidates keep them all."""
+    masked = jnp.where(valid, q, -jnp.inf)
+    order = jnp.argsort(-masked, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    return (valid & (ranks < b)).astype(jnp.float32)
+
+
+def dmtt_round_update(
+    state: AggState,
+    adj: jnp.ndarray,
+    claims: jnp.ndarray,
+    probe_accuracy: jnp.ndarray,
+    probe_vacuity: jnp.ndarray,
+    p: DMTTParams,
+) -> Tuple[jnp.ndarray, AggState, Dict[str, jnp.ndarray]]:
+    """One DMTT round over the whole network.
+
+    Mirrors steps 5-10 of the reference round
+    (murmura/dmtt/node_process.py:208-241):
+
+    1. effective collaborators C (no selection yet → G^t rows), exchange
+       mask E = C∧Cᵀ;
+    2. link-reliability EMA over the expected set (state.py:53-57) — on ICI
+       every sent message arrives, so ack ≡ E;
+    3. claim verification against G^t: d_j / x_j count subject j's claimed
+       edges that match / contradict the true row (node_process.py:369-395)
+       — identical for every observer, so computed once per subject;
+    4. Beta-evidence update with forgetting, floored at 0.01, applied only on
+       edges that received a claim (state.py:63-76);
+    5. model-compatibility scores from the batched probe cross-eval, default
+       0.5 where no model arrived (node_process.py:221-225, state.py:139);
+    6. TopB over the *direct* G^t neighbors → C^{t+1}
+       (node_process.py:235-241).
+
+    Args:
+        state: dict with dmtt_c_hat / dmtt_alpha / dmtt_beta / dmtt_collab.
+        adj: [N, N] true G^t adjacency (0/1 float).
+        claims: [N, N] claimed adjacency; row j is subject j's TOPO_CLAIM.
+        probe_accuracy: [N, N], entry [i, j] = accuracy of model j on node
+            i's probe data.
+        probe_vacuity: [N, N] mean vacuity, zeros for softmax models.
+        p: hyperparameters.
+
+    Returns:
+        (exchange_mask E [N, N] float, new state, per-node stats dict).
+    """
+    adj_b = adj > 0
+    collab = state["dmtt_collab"]
+    # All-zero collab = no TopB selection has happened yet — use G^t directly.
+    collab_eff = jnp.where(jnp.any(collab > 0), collab, adj)
+    collab_b = collab_eff > 0
+    exchange = collab_b & collab_b.T
+
+    # --- link reliability (expected = C_i row; received ≡ exchange) --------
+    ack = exchange.astype(jnp.float32)
+    c_hat = jnp.where(
+        collab_b,
+        (1.0 - p.rho) * state["dmtt_c_hat"] + p.rho * ack,
+        state["dmtt_c_hat"],
+    )
+
+    # --- claim verification (per subject j, same for all observers) --------
+    claims_b = claims > 0
+    d = jnp.sum(claims_b & adj_b, axis=1).astype(jnp.float32)  # [N]
+    x = jnp.sum(claims_b & ~adj_b, axis=1).astype(jnp.float32)  # [N]
+
+    alpha_new = p.lambda_forget * state["dmtt_alpha"] + p.w_d * d[None, :]
+    beta_new = p.lambda_forget * state["dmtt_beta"] + p.w_x * x[None, :]
+    alpha = jnp.where(exchange, jnp.maximum(0.01, alpha_new), state["dmtt_alpha"])
+    beta = jnp.where(exchange, jnp.maximum(0.01, beta_new), state["dmtt_beta"])
+
+    # --- scores + TopB over direct G^t neighbors ---------------------------
+    s_model = model_score(probe_accuracy, probe_vacuity, p)
+    s_model = jnp.where(exchange, s_model, 0.5)
+    t = topo_trust(alpha, beta, p)
+    q = collab_score(s_model, t, c_hat, p)
+    candidates = adj_b & ~jnp.eye(adj.shape[0], dtype=bool)
+    collab_next = _top_b_mask(q, candidates, p.budget_B)
+
+    new_state = {
+        "dmtt_c_hat": c_hat,
+        "dmtt_alpha": alpha,
+        "dmtt_beta": beta,
+        "dmtt_collab": collab_next,
+    }
+    stats = {
+        "dmtt_collab_count": collab_next.sum(axis=1),
+        "dmtt_received_count": ack.sum(axis=1),
+        "dmtt_mean_topo_trust": (t * candidates).sum(axis=1)
+        / jnp.maximum(candidates.sum(axis=1), 1.0),
+    }
+    return ack, new_state, stats

@@ -129,6 +129,12 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         total_rounds=rounds,
     )
 
+    dmtt = None
+    if config.dmtt is not None:
+        from murmura_tpu.dmtt.protocol import DMTTParams
+
+        dmtt = DMTTParams(**config.dmtt.model_dump())
+
     program = build_round_program(
         model,
         agg,
@@ -142,6 +148,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         probe_size=probe_size,
         annealing_rounds=max(1, rounds // 2),
         lambda_weight=0.1,
+        dmtt=dmtt,
     )
 
     if config.backend == "tpu" and mesh is None:

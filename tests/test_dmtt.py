@@ -1,0 +1,398 @@
+"""DMTT trust-protocol tests (reference semantics: murmura/dmtt/).
+
+Closed-form checks of the trust math (state.py:53-142) plus end-to-end
+liar-exclusion: topology liars' falsified claims must drive their Beta trust
+down until TopB stops selecting them (node_process.py:150-250).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from murmura_tpu.attacks.topology_liar import false_claims
+from murmura_tpu.dmtt.protocol import (
+    DMTTParams,
+    collab_score,
+    dmtt_round_update,
+    init_dmtt_state,
+    model_score,
+    topo_trust,
+)
+
+P = DMTTParams()
+
+
+class TestTrustMath:
+    def test_topo_trust_prior(self):
+        """Beta(1,1) prior: R=0.5, U=sqrt(1/12)≈0.2887 < tau_U=0.3 — no
+        penalty (state.py:82-94)."""
+        t = float(topo_trust(jnp.ones(()), jnp.ones(()), P))
+        assert t == pytest.approx(0.5, abs=1e-6)
+
+    def test_topo_trust_monotone_in_evidence(self):
+        """Positive evidence raises trust; negative evidence lowers it."""
+        t_good = float(topo_trust(jnp.asarray(10.0), jnp.asarray(1.0), P))
+        t_bad = float(topo_trust(jnp.asarray(1.0), jnp.asarray(10.0), P))
+        t_prior = float(topo_trust(jnp.asarray(1.0), jnp.asarray(1.0), P))
+        assert t_good > t_prior > t_bad
+
+    def test_topo_trust_uncertainty_penalty(self):
+        """Same mean, higher posterior std above tau_U gets the exp penalty."""
+        # Beta(0.5, 0.5): R=0.5, U=sqrt(0.25/2)=0.3536 > 0.3
+        t = float(topo_trust(jnp.asarray(0.5), jnp.asarray(0.5), P))
+        u = np.sqrt(0.25 / (1.0 * 2.0))
+        expected = 0.5 * np.exp(-P.eta * (u - P.tau_U))
+        assert t == pytest.approx(expected, rel=1e-5)
+
+    def test_model_score_formula(self):
+        """s = (1-u)(w_a*a + 1-w_a), exp penalty above tau_u, floored at 0
+        (state.py:100-110)."""
+        s = float(model_score(jnp.asarray(0.9), jnp.asarray(0.0), P))
+        assert s == pytest.approx(0.7 * 0.9 + 0.3, rel=1e-6)
+        # above threshold: * exp(-(u - tau_u))
+        s_pen = float(model_score(jnp.asarray(0.9), jnp.asarray(0.8), P))
+        expected = (1 - 0.8) * (0.7 * 0.9 + 0.3) * np.exp(-(0.8 - 0.5))
+        assert s_pen == pytest.approx(expected, rel=1e-5)
+
+    def test_collab_score_weights(self):
+        q = float(
+            collab_score(jnp.asarray(1.0), jnp.asarray(1.0), jnp.asarray(1.0), P)
+        )
+        assert q == pytest.approx(P.lambda1 + P.lambda2 + P.lambda3, rel=1e-6)
+
+
+class TestRoundUpdate:
+    def _ring_adj(self, n):
+        adj = np.zeros((n, n), np.float32)
+        for i in range(n):
+            adj[i, (i + 1) % n] = adj[i, (i - 1) % n] = 1.0
+        return jnp.asarray(adj)
+
+    def test_honest_claims_raise_alpha(self):
+        """All-honest ring: every received claim fully matches G^t, so alpha
+        grows by w_d * degree and beta only decays (state.py:63-76)."""
+        n = 6
+        adj = self._ring_adj(n)
+        state = init_dmtt_state(n)
+        acc = jnp.full((n, n), 0.9)
+        vac = jnp.zeros((n, n))
+        ack, new_state, stats = dmtt_round_update(state, adj, adj, acc, vac, P)
+        alpha = np.asarray(new_state["dmtt_alpha"])
+        beta = np.asarray(new_state["dmtt_beta"])
+        exchanged = np.asarray(ack) > 0
+        # d_j = 2 for every ring node; alpha = 0.9*1 + 1.0*2 = 2.9 on edges
+        np.testing.assert_allclose(alpha[exchanged], 2.9, rtol=1e-6)
+        np.testing.assert_allclose(beta[exchanged], 0.9, rtol=1e-6)
+        # non-exchanged edges untouched
+        np.testing.assert_allclose(alpha[~exchanged], 1.0)
+
+    def test_round0_uses_adjacency(self):
+        """Round 0 has no TopB selection yet — exchange = G^0 (symmetric ring)
+        (node_process.py:111-118)."""
+        n = 5
+        adj = self._ring_adj(n)
+        ack, _, _ = dmtt_round_update(
+            init_dmtt_state(n),
+            adj,
+            adj,
+            jnp.full((n, n), 0.5),
+            jnp.zeros((n, n)),
+            P,
+        )
+        np.testing.assert_array_equal(np.asarray(ack), np.asarray(adj))
+
+    def test_later_rounds_use_collab_intersection(self):
+        """After round 0 the exchange is C ∧ Cᵀ, not G^t."""
+        n = 4
+        adj = jnp.ones((n, n)) - jnp.eye(n)
+        state = init_dmtt_state(n)
+        # node 0 collaborates only with 1; others with everyone
+        collab = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
+        collab[0] = 0.0
+        collab[0, 1] = 1.0
+        state = {**state, "dmtt_collab": jnp.asarray(collab)}
+        ack, _, _ = dmtt_round_update(
+            state,
+            adj,
+            adj,
+            jnp.full((n, n), 0.5),
+            jnp.zeros((n, n)),
+            P,
+        )
+        ack = np.asarray(ack)
+        assert ack[0, 1] == 1.0 and ack[1, 0] == 1.0
+        assert ack[0, 2] == 0.0 and ack[2, 0] == 0.0  # 2 sent, 0 didn't expect
+
+    def test_liar_loses_trust_and_collaborators(self):
+        """Falsified claims (true ∪ coalition, topology_liar.py:78-102) add
+        contradictions every round.  On a ring each liar's claim is 2 true
+        edges + false coalition edges, so Beta trust converges to
+        d/(d+x) ≈ 2/3 under forgetting (state.py:63-94) — clearly below the
+        honest steady state ≈ 1.0 — and TopB with budget 1 then prefers the
+        honest neighbor over the liar (state.py:128-142)."""
+        n = 8
+        adj = self._ring_adj(n)
+        compromised = np.zeros(n, np.float32)
+        compromised[2] = compromised[5] = 1.0
+        comp = jnp.asarray(compromised)
+        claims = false_claims(adj, comp)
+        # equal probe accuracy everywhere: trust, not accuracy, must drive
+        # the exclusion
+        acc = jnp.full((n, n), 0.9)
+        vac = jnp.zeros((n, n))
+        p = DMTTParams(budget_B=1)
+
+        state = init_dmtt_state(n)
+        for r in range(6):
+            _, state, stats = dmtt_round_update(state, adj, claims, acc, vac, p)
+        t = np.asarray(topo_trust(state["dmtt_alpha"], state["dmtt_beta"], p))
+        honest = compromised == 0
+        byz = compromised == 1
+        # only adjacent pairs ever exchange claims (non-edges keep the prior)
+        adj_np = np.asarray(adj) > 0
+        h_b = adj_np & honest[:, None] & byz[None, :]
+        h_h = adj_np & honest[:, None] & honest[None, :]
+        t_in_byz = t[h_b].mean()
+        t_in_honest = t[h_h].mean()
+        assert t_in_byz < t_in_honest - 0.1, (t_in_byz, t_in_honest)
+        # with B=1, every honest node adjacent to one liar and one honest
+        # neighbor must pick the honest one
+        collab = np.asarray(state["dmtt_collab"])
+        for i, h in ((1, 0), (3, 4), (4, 3), (6, 7)):
+            assert collab[i, h] == 1.0, f"node {i} did not pick honest {h}"
+            liar = 2 if i in (1, 3) else 5
+            assert collab[i, liar] == 0.0, f"node {i} still picks liar {liar}"
+        assert stats["dmtt_collab_count"].shape == (n,)
+
+    def test_topb_budget_respected(self):
+        n = 6
+        adj = jnp.ones((n, n), jnp.float32) - jnp.eye(n, dtype=jnp.float32)
+        p = DMTTParams(budget_B=2)
+        _, state, stats = dmtt_round_update(
+            init_dmtt_state(n),
+            adj,
+            adj,
+            jnp.full((n, n), 0.5),
+            jnp.zeros((n, n)),
+            p,
+        )
+        counts = np.asarray(stats["dmtt_collab_count"])
+        assert (counts <= 2).all() and (counts >= 1).all()
+
+    def test_topb_prefers_higher_model_score(self):
+        """With equal trust, the candidate with better probe accuracy wins
+        the budget slot (state.py:128-142)."""
+        n = 4
+        adj = jnp.ones((n, n), jnp.float32) - jnp.eye(n, dtype=jnp.float32)
+        acc = jnp.asarray(
+            np.stack([np.linspace(0.1, 0.9, n)] * n).astype(np.float32)
+        )  # every observer sees subject j's accuracy grow with j
+        p = DMTTParams(budget_B=1)
+        _, state, _ = dmtt_round_update(
+            init_dmtt_state(n),
+            adj,
+            adj,
+            acc,
+            jnp.zeros((n, n)),
+            p,
+        )
+        collab = np.asarray(state["dmtt_collab"])
+        # everyone (except node 3 itself) picks node 3, the highest-accuracy
+        for i in range(3):
+            assert collab[i, 3] == 1.0
+
+
+class TestEndToEnd:
+    def test_dmtt_simulation_excludes_liars(self):
+        """Full config-driven run: mobility + topology_liar + DMTT.  Liars'
+        mean selection by honest nodes must fall well below honest peers'."""
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        n = 8
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "dmtt-test", "seed": 3, "rounds": 6},
+                "topology": {"type": "fully", "num_nodes": n},
+                "aggregation": {"algorithm": "fedavg", "params": {}},
+                "attack": {
+                    "enabled": True,
+                    "type": "topology_liar",
+                    "percentage": 0.25,
+                    "params": {"model_attack_type": "gaussian", "noise_std": 5.0},
+                },
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {
+                    "adapter": "synthetic",
+                    "params": {
+                        "num_samples": 16 * n,
+                        "input_shape": [10],
+                        "num_classes": 3,
+                    },
+                },
+                "model": {
+                    "factory": "mlp",
+                    "params": {
+                        "input_dim": 10,
+                        "hidden_dims": [16],
+                        "num_classes": 3,
+                    },
+                },
+                "mobility": {
+                    "area_size": 50.0,
+                    "comm_range": 40.0,
+                    "max_speed": 5.0,
+                    "seed": 11,
+                },
+                "dmtt": {"budget_B": 3},
+            }
+        )
+        net = build_network_from_config(cfg)
+        history = net.train(rounds=6)
+        assert len(history["round"]) == 6
+        assert np.isfinite(history["mean_accuracy"]).all()
+
+        collab = np.asarray(net.agg_state["dmtt_collab"])
+        comp = net.attack.compromised
+        honest = ~comp
+        picked_byz = collab[np.ix_(honest, comp)].mean()
+        picked_honest = collab[np.ix_(honest, honest)].mean()
+        assert picked_byz < picked_honest, (
+            f"liars still selected: byz={picked_byz:.3f} honest={picked_honest:.3f}"
+        )
+
+        stats = net.get_node_statistics()
+        assert "dmtt_collab_count" in stats[0]
+
+
+class TestProbeCrossReuse:
+    """The shared cross-eval handed to probe-based rules via ctx.probe_cross
+    must be interchangeable with each rule's standalone recompute."""
+
+    def _ctx(self, evidential, n=4, b=6, dim=5, k=3, seed=0):
+        import jax
+
+        from murmura_tpu.aggregation.base import AggContext
+        from murmura_tpu.models.registry import build_model
+        from murmura_tpu.ops.flatten import make_flatteners
+
+        params = {
+            "input_dim": dim,
+            "hidden_dims": [8],
+            "num_classes": k,
+            "evidential": evidential,
+        }
+        model = build_model("mlp", params)
+        rng = np.random.default_rng(seed)
+        template = model.init(jax.random.PRNGKey(0))
+        ravel, unravel, p_dim = make_flatteners(template)
+        flat = jnp.asarray(
+            rng.normal(size=(n, p_dim)).astype(np.float32)
+        )
+        ctx = AggContext(
+            apply_fn=model.apply,
+            unravel=unravel,
+            probe_x=jnp.asarray(rng.normal(size=(n, b, dim)).astype(np.float32)),
+            probe_y=jnp.asarray(rng.integers(0, k, size=(n, b)).astype(np.int32)),
+            probe_mask=jnp.ones((n, b), jnp.float32),
+            evidential=evidential,
+            num_classes=k,
+            total_rounds=5,
+        )
+        return flat, ctx
+
+    def test_combined_metric_matches_standalone(self):
+        """combined_probe_metric emits the same loss as ce_loss_metric and
+        the same accuracy/vacuity as the per-rule metrics, on both model
+        families."""
+        from murmura_tpu.aggregation.probe import (
+            ce_loss_metric,
+            combined_probe_metric,
+            evidential_trust_metric,
+            pairwise_probe_eval,
+        )
+
+        for evidential in (False, True):
+            flat, ctx = self._ctx(evidential)
+            combined = pairwise_probe_eval(
+                flat, ctx, combined_probe_metric(evidential)
+            )
+            loss = pairwise_probe_eval(flat, ctx, ce_loss_metric)["loss"]
+            np.testing.assert_allclose(
+                np.asarray(combined["loss"]), np.asarray(loss), rtol=1e-6
+            )
+            if evidential:
+                ev = pairwise_probe_eval(flat, ctx, evidential_trust_metric)
+                for key in ("accuracy", "vacuity", "entropy", "strength"):
+                    np.testing.assert_allclose(
+                        np.asarray(combined[key]), np.asarray(ev[key]), rtol=1e-6
+                    )
+
+    def test_rules_identical_with_and_without_probe_cross(self):
+        """UBAR and evidential_trust produce bit-identical outputs whether
+        they recompute the cross-eval or reuse ctx.probe_cross."""
+        import dataclasses
+
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.aggregation.probe import (
+            combined_probe_metric,
+            pairwise_probe_eval,
+        )
+
+        for name, evidential in (("ubar", False), ("evidential_trust", True)):
+            flat, ctx = self._ctx(evidential)
+            n = flat.shape[0]
+            adj = jnp.ones((n, n), jnp.float32) - jnp.eye(n, dtype=jnp.float32)
+            agg = build_aggregator(name, {}, model_dim=flat.shape[1], total_rounds=5)
+            state = {k: jnp.asarray(v) for k, v in agg.init_state(n).items()}
+            cross = pairwise_probe_eval(flat, ctx, combined_probe_metric(evidential))
+            ctx_pre = dataclasses.replace(ctx, probe_cross=cross)
+
+            out_a, _, _ = agg.aggregate(flat, flat, adj, jnp.asarray(1.0), state, ctx)
+            out_b, _, _ = agg.aggregate(
+                flat, flat, adj, jnp.asarray(1.0), state, ctx_pre
+            )
+            np.testing.assert_array_equal(np.asarray(out_a), np.asarray(out_b))
+
+    def test_dmtt_with_ubar_end_to_end(self):
+        """DMTT gating composes with a probe-based rule (shared cross-eval
+        path live in the full round step)."""
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        n = 6
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "dmtt-ubar", "seed": 1, "rounds": 3},
+                "topology": {"type": "fully", "num_nodes": n},
+                "aggregation": {"algorithm": "ubar", "params": {}},
+                "attack": {
+                    "enabled": True,
+                    "type": "topology_liar",
+                    "percentage": 0.2,
+                    "params": {"model_attack_type": "gaussian", "noise_std": 5.0},
+                },
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {
+                    "adapter": "synthetic",
+                    "params": {
+                        "num_samples": 12 * n,
+                        "input_shape": [8],
+                        "num_classes": 3,
+                    },
+                },
+                "model": {
+                    "factory": "mlp",
+                    "params": {
+                        "input_dim": 8,
+                        "hidden_dims": [16],
+                        "num_classes": 3,
+                    },
+                },
+                "mobility": {"comm_range": 80.0, "seed": 2},
+                "dmtt": {"budget_B": 3},
+            }
+        )
+        net = build_network_from_config(cfg)
+        history = net.train(rounds=3)
+        assert np.isfinite(history["mean_accuracy"]).all()

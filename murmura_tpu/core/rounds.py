@@ -51,7 +51,13 @@ from murmura_tpu.ops.losses import (
 )
 
 
-DMTT_STATE_KEYS = ("dmtt_c_hat", "dmtt_alpha", "dmtt_beta", "dmtt_collab")
+DMTT_STATE_KEYS = (
+    "dmtt_c_hat",
+    "dmtt_alpha",
+    "dmtt_beta",
+    "dmtt_collab",
+    "dmtt_selected",
+)
 
 
 @dataclass(frozen=True)

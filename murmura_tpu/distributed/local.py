@@ -106,6 +106,7 @@ class LocalNode:
         self._train_fn = jax.jit(self._build_train_fn())
         self._eval_fn = jax.jit(self._build_eval_fn())
         self._agg_fn = jax.jit(self._build_agg_fn())
+        self._probe_eval_fn = jax.jit(self._build_probe_eval_fn())
         self._last_stats: Dict[str, float] = {}
 
     # ------------------------------------------------------------------
@@ -178,6 +179,26 @@ class LocalNode:
             return {"loss": loss, "accuracy": acc}
 
         return evaluate
+
+    def _build_probe_eval_fn(self):
+        """Score an arbitrary flat state on this node's probe data — DMTT
+        model-compatibility scoring (reference: murmura/dmtt/
+        node_process.py:309-363)."""
+        model = self.model
+        evidential = self.evidential
+        unravel = self._unravel
+
+        def probe_eval(flat):
+            params = unravel(flat)
+            out = model.apply(params, self._probe_x, None, False)
+            acc = (jnp.argmax(out, -1) == self._probe_y).mean()
+            if evidential:
+                vac = uncertainty_metrics(out)["vacuity"].mean()
+            else:
+                vac = jnp.zeros(())
+            return {"accuracy": acc, "vacuity": vac}
+
+        return probe_eval
 
     # ------------------------------------------------------------------
     # aggregation via the shared vectorized rules
@@ -253,6 +274,11 @@ class LocalNode:
 
     def evaluate(self) -> Dict[str, float]:
         return {k: float(v) for k, v in self._eval_fn(self.params).items()}
+
+    def probe_eval_flat(self, flat: np.ndarray) -> Dict[str, float]:
+        """Accuracy + vacuity of a neighbor's flat state on local probe data."""
+        out = self._probe_eval_fn(jnp.asarray(flat))
+        return {k: float(v) for k, v in out.items()}
 
     def aggregate_with_neighbors(
         self, neighbor_states: Dict[int, np.ndarray], round_num: int

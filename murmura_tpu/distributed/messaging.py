@@ -1,10 +1,13 @@
 """Wire format (reference: murmura/distributed/messaging.py:11-78).
 
-2-frame multipart: header = struct("!Bi") (1-byte MsgType + 4-byte sender
-id), then the payload.  Model states travel as flattened float32 parameter
-vectors serialized with numpy (the reference ships full torch state dicts
-via torch.save — flat vectors are both smaller and exactly what the
-aggregation rules consume); metrics/claims use pickle.
+2-frame multipart: header = struct("!Bii") (1-byte MsgType + 4-byte sender
+id + 4-byte round tag), then the payload.  The round tag lets receivers drop
+stale messages that arrive after their round's deadline — the reference's
+untagged states can be mistaken for the next round's broadcast.  Model
+states travel as flattened float32 parameter vectors serialized with numpy
+(the reference ships full torch state dicts via torch.save — flat vectors
+are both smaller and exactly what the aggregation rules consume);
+metrics/claims use pickle.
 """
 
 import io
@@ -15,7 +18,7 @@ from typing import Any, Tuple
 
 import numpy as np
 
-_HEADER = struct.Struct("!Bi")
+_HEADER = struct.Struct("!Bii")
 
 
 class MsgType(IntEnum):
@@ -44,14 +47,16 @@ def unpack_obj(payload: bytes) -> Any:
     return pickle.loads(payload)
 
 
-def encode(msg_type: MsgType, sender: int, payload: bytes) -> Tuple[bytes, bytes]:
+def encode(
+    msg_type: MsgType, sender: int, payload: bytes, round_idx: int
+) -> Tuple[bytes, bytes]:
     """Build the 2-frame multipart message."""
-    return _HEADER.pack(int(msg_type), sender), payload
+    return _HEADER.pack(int(msg_type), sender, round_idx), payload
 
 
-def decode(frames) -> Tuple[MsgType, int, bytes]:
-    """Parse a received multipart message."""
+def decode(frames) -> Tuple[MsgType, int, int, bytes]:
+    """Parse a received multipart message -> (type, sender, round, payload)."""
     if len(frames) != 2:
         raise ValueError(f"Expected 2 frames, got {len(frames)}")
-    msg_type, sender = _HEADER.unpack(frames[0])
-    return MsgType(msg_type), sender, frames[1]
+    msg_type, sender, round_idx = _HEADER.unpack(frames[0])
+    return MsgType(msg_type), sender, round_idx, frames[1]

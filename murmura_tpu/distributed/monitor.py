@@ -65,7 +65,7 @@ class Monitor:
                     break
                 events = dict(poller.poll(200))
                 if sock in events:
-                    msg_type, sender, payload = decode(sock.recv_multipart())
+                    msg_type, sender, _round, payload = decode(sock.recv_multipart())
                     if msg_type == MsgType.METRICS:
                         self._ingest(unpack_obj(payload))
                 self._flush_complete()

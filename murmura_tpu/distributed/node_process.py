@@ -230,14 +230,15 @@ class NodeProcess:
         for nid in neighbors:
             try:
                 self._push_to(nid).send_multipart(
-                    encode(MsgType.MODEL_STATE, self.node_id, payload), copy=False
+                    encode(MsgType.MODEL_STATE, self.node_id, payload, round_idx),
+                    copy=False,
                 )
             except Exception as e:  # pragma: no cover - socket teardown races
                 print(f"[node {self.node_id}] push to {nid} failed: {e}", flush=True)
 
         # 5. collect neighbor states until expected or deadline
         # (node_process.py:249-276)
-        received = self._collect_states(set(neighbors), deadline)
+        received = self._collect_states(set(neighbors), round_idx, deadline)
 
         # 6. aggregate with whatever arrived (partial OK)
         if received:
@@ -261,7 +262,9 @@ class NodeProcess:
         )
         return np.asarray(out[0], dtype=np.float32)
 
-    def _collect_states(self, expected: set, deadline: float) -> Dict[int, np.ndarray]:
+    def _collect_states(
+        self, expected: set, round_idx: int, deadline: float
+    ) -> Dict[int, np.ndarray]:
         import zmq
 
         received: Dict[int, np.ndarray] = {}
@@ -271,8 +274,15 @@ class NodeProcess:
             timeout_ms = max(1, int((deadline - time.monotonic()) * 1000))
             events = dict(poller.poll(min(timeout_ms, 200)))
             if self._pull in events:
-                msg_type, sender, payload = decode(self._pull.recv_multipart())
-                if msg_type == MsgType.MODEL_STATE and sender in expected:
+                msg_type, sender, msg_round, payload = decode(
+                    self._pull.recv_multipart()
+                )
+                # round tag drops stragglers from earlier round windows
+                if (
+                    msg_type == MsgType.MODEL_STATE
+                    and sender in expected
+                    and msg_round == round_idx
+                ):
                     received[sender] = unpack_state(payload)
         missing = expected - set(received)
         if missing:
@@ -291,7 +301,7 @@ class NodeProcess:
         metrics["compromised"] = self.is_compromised
         try:
             self._monitor_push.send_multipart(
-                encode(MsgType.METRICS, self.node_id, pack_obj(metrics))
+                encode(MsgType.METRICS, self.node_id, pack_obj(metrics), round_idx)
             )
         except Exception as e:  # pragma: no cover
             print(f"[node {self.node_id}] metrics push failed: {e}", flush=True)

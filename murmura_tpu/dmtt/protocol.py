@@ -34,6 +34,10 @@ class DMTTParams:
     rho: float = 0.1
     lambda_forget: float = 0.9
     w_d: float = 1.0
+    # w_c (corroboration) and collab_score's c_comm exist as tunables in the
+    # reference schema but its round loop never feeds them non-default values
+    # (reference: state.py:68+116 defaults, node_process.py:395 passes only
+    # d/x) — kept for config parity, inert by the same design.
     w_c: float = 0.5
     w_x: float = 1.0
     tau_U: float = 0.3
@@ -49,11 +53,12 @@ class DMTTParams:
 def init_dmtt_state(num_nodes: int) -> AggState:
     """Initial trust state (reference: murmura/dmtt/state.py:42-47).
 
-    ``dmtt_collab`` starts all-zero as the no-selection-yet sentinel
-    (the reference's ``self._collaborators is None``,
-    murmura/dmtt/node_process.py:111-118): while it is all-zero the round
-    uses the G^t adjacency directly, and the first TopB selection writes the
-    real mask.  Keying on the state itself (not the round index) keeps a
+    ``dmtt_selected`` is the explicit no-selection-yet flag (the reference's
+    ``self._collaborators is None``, murmura/dmtt/node_process.py:111-118):
+    while 0 the round uses the G^t adjacency directly, and the first TopB
+    selection sets it — so a legitimately empty TopB result (e.g. a round
+    with no physical neighbors under mobility) is NOT confused with "never
+    selected".  Keying on carried state (not the round index) keeps a
     resumed ``train()`` call from discarding the learned selection.
     """
     n = num_nodes
@@ -62,6 +67,7 @@ def init_dmtt_state(num_nodes: int) -> AggState:
         "dmtt_alpha": jnp.ones((n, n), jnp.float32),
         "dmtt_beta": jnp.ones((n, n), jnp.float32),
         "dmtt_collab": jnp.zeros((n, n), jnp.float32),
+        "dmtt_selected": jnp.zeros((), jnp.float32),
     }
 
 
@@ -156,8 +162,8 @@ def dmtt_round_update(
     """
     adj_b = adj > 0
     collab = state["dmtt_collab"]
-    # All-zero collab = no TopB selection has happened yet — use G^t directly.
-    collab_eff = jnp.where(jnp.any(collab > 0), collab, adj)
+    # No TopB selection has happened yet — use G^t directly.
+    collab_eff = jnp.where(state["dmtt_selected"] > 0, collab, adj)
     collab_b = collab_eff > 0
     exchange = collab_b & collab_b.T
 
@@ -192,6 +198,7 @@ def dmtt_round_update(
         "dmtt_alpha": alpha,
         "dmtt_beta": beta,
         "dmtt_collab": collab_next,
+        "dmtt_selected": jnp.ones((), jnp.float32),
     }
     stats = {
         "dmtt_collab_count": collab_next.sum(axis=1),

@@ -26,16 +26,16 @@ from murmura_tpu.distributed.messaging import (
 class TestMessaging:
     def test_state_roundtrip(self):
         flat = np.random.default_rng(0).normal(size=1000).astype(np.float32)
-        header, payload = encode(MsgType.MODEL_STATE, 3, pack_state(flat))
-        msg_type, sender, body = decode([header, payload])
-        assert msg_type == MsgType.MODEL_STATE and sender == 3
+        header, payload = encode(MsgType.MODEL_STATE, 3, pack_state(flat), 5)
+        msg_type, sender, msg_round, body = decode([header, payload])
+        assert msg_type == MsgType.MODEL_STATE and sender == 3 and msg_round == 5
         np.testing.assert_array_equal(unpack_state(body), flat)
 
     def test_obj_roundtrip(self):
         metrics = {"round": 2, "accuracy": 0.93, "stats": {"a": 1.0}}
-        header, payload = encode(MsgType.METRICS, 0, pack_obj(metrics))
-        msg_type, sender, body = decode([header, payload])
-        assert msg_type == MsgType.METRICS
+        header, payload = encode(MsgType.METRICS, 0, pack_obj(metrics), 2)
+        msg_type, sender, msg_round, body = decode([header, payload])
+        assert msg_type == MsgType.METRICS and msg_round == 2
         assert unpack_obj(body) == metrics
 
     def test_decode_rejects_bad_frame_count(self):

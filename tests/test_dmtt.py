@@ -110,7 +110,11 @@ class TestRoundUpdate:
         collab = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
         collab[0] = 0.0
         collab[0, 1] = 1.0
-        state = {**state, "dmtt_collab": jnp.asarray(collab)}
+        state = {
+            **state,
+            "dmtt_collab": jnp.asarray(collab),
+            "dmtt_selected": jnp.ones((), jnp.float32),
+        }
         ack, _, _ = dmtt_round_update(
             state,
             adj,
@@ -122,6 +126,23 @@ class TestRoundUpdate:
         ack = np.asarray(ack)
         assert ack[0, 1] == 1.0 and ack[1, 0] == 1.0
         assert ack[0, 2] == 0.0 and ack[2, 0] == 0.0  # 2 sent, 0 didn't expect
+
+    def test_empty_selection_not_confused_with_no_selection(self):
+        """A legitimately empty TopB result (isolated node under mobility)
+        must NOT fall back to the raw adjacency the following round — only
+        the never-selected state does (dmtt_selected flag)."""
+        n = 4
+        state = init_dmtt_state(n)
+        state = {
+            **state,
+            "dmtt_collab": jnp.zeros((n, n), jnp.float32),  # selected nothing
+            "dmtt_selected": jnp.ones((), jnp.float32),
+        }
+        adj = jnp.ones((n, n), jnp.float32) - jnp.eye(n, dtype=jnp.float32)
+        ack, _, _ = dmtt_round_update(
+            state, adj, adj, jnp.full((n, n), 0.5), jnp.zeros((n, n)), P
+        )
+        np.testing.assert_array_equal(np.asarray(ack), 0.0)
 
     def test_liar_loses_trust_and_collaborators(self):
         """Falsified claims (true ∪ coalition, topology_liar.py:78-102) add

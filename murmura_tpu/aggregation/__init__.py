@@ -37,6 +37,10 @@ def build_aggregator(
         raise ValueError(f"Unknown aggregation algorithm: {algorithm}")
     params = dict(params or {})
     params.pop("total_rounds", None)  # carried via AggContext instead
+    if algo == "krum" and "f" in params:
+        # Reference configs name the Byzantine tolerance "f"
+        # (examples/configs/uci_har_byzantine.yaml).
+        params.setdefault("num_compromised", params.pop("f"))
     if algo == "sketchguard":
         params.setdefault("model_dim", model_dim)
     return AGGREGATORS[algo](**params)

@@ -28,7 +28,13 @@ def app():
 @click.option("--verbose/--quiet", "verbose", default=None, help="Override config verbosity")
 @click.option("--output", "-o", type=click.Path(path_type=Path), default=None,
               help="Write history JSON here")
-def run(config_path: Path, verbose, output):
+@click.option("--checkpoint-dir", type=click.Path(path_type=Path), default=None,
+              help="Write per-round checkpoints here (simulation/tpu backends)")
+@click.option("--checkpoint-every", type=int, default=5,
+              help="Rounds between checkpoints (with --checkpoint-dir)")
+@click.option("--resume/--no-resume", default=False,
+              help="Resume from --checkpoint-dir if a checkpoint exists")
+def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every, resume):
     """Run an experiment from a config file (reference: cli.py:34-60)."""
     config = load_config(config_path)
     if verbose is not None:
@@ -43,6 +49,11 @@ def run(config_path: Path, verbose, output):
     set_seed(config.experiment.seed)
 
     if config.backend == "distributed":
+        if resume or checkpoint_dir is not None:
+            raise click.UsageError(
+                "--checkpoint-dir/--resume are not supported with "
+                "backend: distributed (state lives in per-node processes)"
+            )
         from murmura_tpu.distributed.runner import DistributedRunner
 
         history = DistributedRunner(config).run()
@@ -50,9 +61,25 @@ def run(config_path: Path, verbose, output):
         from murmura_tpu.utils.factories import build_network_from_config
 
         network = build_network_from_config(config)
+        if resume:
+            if checkpoint_dir is None:
+                raise click.UsageError("--resume requires --checkpoint-dir")
+            from murmura_tpu.utils.checkpoint import has_checkpoint
+
+            if has_checkpoint(checkpoint_dir):
+                done = network.restore_checkpoint(str(checkpoint_dir))
+                console.print(f"Resumed from round [bold]{done}[/bold]")
+            else:
+                console.print(
+                    f"[yellow]No checkpoint in {checkpoint_dir}; "
+                    "starting from round 0[/yellow]"
+                )
+        remaining = config.experiment.rounds - network.current_round
         history = network.train(
-            rounds=config.experiment.rounds,
+            rounds=max(0, remaining),
             verbose=config.experiment.verbose,
+            checkpoint_dir=str(checkpoint_dir) if checkpoint_dir else None,
+            checkpoint_every=checkpoint_every,
         )
 
     _display_results(history)

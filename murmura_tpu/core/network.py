@@ -33,6 +33,7 @@ class Network:
         mesh=None,
         seed: int = 42,
         donate: bool = True,
+        profile_dir: Optional[str] = None,
     ):
         self.program = program
         self.topology = topology
@@ -40,6 +41,7 @@ class Network:
         self.mobility = mobility
         self.backend = backend
         self.seed = seed
+        self.profile_dir = profile_dir
 
         n = program.num_nodes
         if topology.num_nodes != n:
@@ -87,6 +89,10 @@ class Network:
         }
         self._last_stats: Dict[str, np.ndarray] = {}
         self.round_times: List[float] = []
+        # Persistent round counter: schedules (BALANCE/trust tightening,
+        # evidential-loss annealing) and the mobility G^t keep advancing
+        # across successive train() calls and checkpoint resumes.
+        self.current_round = 0
 
     def _adjacency_for_round(self, round_idx: int) -> np.ndarray:
         if self.mobility is not None:
@@ -98,15 +104,39 @@ class Network:
         rounds: int,
         verbose: bool = False,
         eval_every: int = 1,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
     ) -> Dict[str, List[Any]]:
         """Run the FL rounds (reference: network.py:60-94).
 
         Note: evaluation metrics are computed inside the fused round step at
         every round; ``eval_every`` controls which rounds are *recorded*,
         matching the reference's eval cadence semantics.
+
+        Args:
+            checkpoint_dir: if set, write a checkpoint after every
+                ``checkpoint_every`` rounds (and at the end). No reference
+                counterpart — the reference keeps all state in memory.
         """
+        profile = self.profile_dir is not None
+        if profile:
+            jax.profiler.start_trace(self.profile_dir)
+        try:
+            self._train_rounds(
+                rounds, verbose, eval_every, checkpoint_dir, checkpoint_every
+            )
+        finally:
+            if profile:
+                jax.profiler.stop_trace()
+        return self.history
+
+    def _train_rounds(
+        self, rounds, verbose, eval_every, checkpoint_dir, checkpoint_every
+    ) -> None:
         comp = jnp.asarray(self.compromised)
-        for round_idx in range(rounds):
+        last_saved = -1
+        for _ in range(rounds):
+            round_idx = self.current_round
             t0 = time.perf_counter()
             adj = jnp.asarray(self._adjacency_for_round(round_idx))
             self._rng, step_key = jax.random.split(self._rng)
@@ -119,11 +149,52 @@ class Network:
                 jnp.asarray(round_idx, dtype=jnp.float32),
                 self._data,
             )
-            if (round_idx + 1) % eval_every == 0:
+            self.current_round = round_idx + 1
+            if self.current_round % eval_every == 0:
                 metrics = jax.device_get(metrics)
-                self._record(round_idx + 1, metrics, verbose)
+                self._record(self.current_round, metrics, verbose)
             self.round_times.append(time.perf_counter() - t0)
-        return self.history
+            if (
+                checkpoint_dir
+                and checkpoint_every
+                and self.current_round % checkpoint_every == 0
+            ):
+                self.save_checkpoint(checkpoint_dir)
+                last_saved = self.current_round
+        if checkpoint_dir and rounds > 0 and self.current_round != last_saved:
+            self.save_checkpoint(checkpoint_dir)
+
+    def save_checkpoint(self, directory: str) -> None:
+        """Snapshot run state to ``directory`` (see utils/checkpoint.py)."""
+        from murmura_tpu.utils.checkpoint import save_checkpoint
+
+        save_checkpoint(
+            directory,
+            params=self.params,
+            agg_state=self.agg_state,
+            rng=self._rng,
+            round_num=self.current_round,
+            history=self.history,
+            round_times=self.round_times,
+        )
+
+    def restore_checkpoint(self, directory: str) -> int:
+        """Restore run state; returns the round to continue from."""
+        from murmura_tpu.utils.checkpoint import restore_checkpoint
+
+        params, agg_state, rng, round_num, history, times = restore_checkpoint(
+            directory,
+            params_target=self.params,
+            agg_state_target=self.agg_state,
+            rng_target=self._rng,
+        )
+        self.params = jax.tree_util.tree_map(jnp.asarray, params)
+        self.agg_state = {k: jnp.asarray(v) for k, v in agg_state.items()}
+        self._rng = jnp.asarray(rng)
+        self.current_round = round_num
+        self.history = history
+        self.round_times = times
+        return round_num
 
     def _record(self, round_num: int, metrics: Dict[str, np.ndarray], verbose: bool):
         acc = np.asarray(metrics["accuracy"])

@@ -1,0 +1,109 @@
+"""Per-round checkpoint/resume for the stacked network state.
+
+The reference has no checkpointing at all — model states live only in
+memory and history is returned at the end of ``train()`` (SURVEY §5;
+reference: murmura/core/network.py:60-94).  Here the whole run state is a
+handful of device arrays (stacked params pytree, aggregator state dict, RNG
+key) plus host-side history, so a checkpoint is one msgpack blob + one JSON
+sidecar:
+
+    <dir>/state.msgpack   flax.serialization bytes of {params, agg_state, rng}
+    <dir>/meta.json       {round, history, round_times, version}
+
+Restore is exact: resuming reproduces the same arrays the run would have had
+at that round boundary.
+"""
+
+import json
+import os
+from pathlib import Path
+from typing import Any, Dict, Tuple
+
+import jax
+import numpy as np
+from flax import serialization
+
+CKPT_VERSION = 1
+STATE_FILE = "state.msgpack"
+META_FILE = "meta.json"
+
+
+def save_checkpoint(
+    directory: str | Path,
+    *,
+    params: Any,
+    agg_state: Dict[str, Any],
+    rng: Any,
+    round_num: int,
+    history: Dict[str, list],
+    round_times: list,
+) -> Path:
+    """Write a checkpoint; returns the directory written."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    blob = serialization.to_bytes(
+        {
+            "params": jax.device_get(params),
+            "agg_state": jax.device_get(agg_state),
+            "rng": jax.device_get(rng),
+        }
+    )
+    meta = json.dumps(
+        {
+            "version": CKPT_VERSION,
+            "round": int(round_num),
+            "history": history,
+            "round_times": [float(t) for t in round_times],
+        }
+    )
+    # Atomic: a kill mid-write must not leave a readable-but-corrupt pair.
+    # State lands before meta so a crash between the two leaves the old
+    # meta pointing at old state, never new meta over truncated state.
+    tmp_state = d / (STATE_FILE + ".tmp")
+    tmp_state.write_bytes(blob)
+    os.replace(tmp_state, d / STATE_FILE)
+    tmp_meta = d / (META_FILE + ".tmp")
+    tmp_meta.write_text(meta)
+    os.replace(tmp_meta, d / META_FILE)
+    return d
+
+
+def restore_checkpoint(
+    directory: str | Path,
+    *,
+    params_target: Any,
+    agg_state_target: Dict[str, Any],
+    rng_target: Any,
+) -> Tuple[Any, Dict[str, Any], Any, int, Dict[str, list], list]:
+    """Load (params, agg_state, rng, round, history, round_times).
+
+    Targets supply the pytree structure/dtypes; shapes are validated by
+    flax.serialization against the saved leaves.
+    """
+    d = Path(directory)
+    meta = json.loads((d / META_FILE).read_text())
+    if meta.get("version") != CKPT_VERSION:
+        raise ValueError(
+            f"Checkpoint version {meta.get('version')} != {CKPT_VERSION}"
+        )
+    state = serialization.from_bytes(
+        {
+            "params": jax.device_get(params_target),
+            "agg_state": jax.device_get(agg_state_target),
+            "rng": jax.device_get(rng_target),
+        },
+        (d / STATE_FILE).read_bytes(),
+    )
+    return (
+        state["params"],
+        state["agg_state"],
+        np.asarray(state["rng"]),
+        int(meta["round"]),
+        meta["history"],
+        list(meta["round_times"]),
+    )
+
+
+def has_checkpoint(directory: str | Path) -> bool:
+    d = Path(directory)
+    return (d / STATE_FILE).exists() and (d / META_FILE).exists()

@@ -30,10 +30,12 @@ def build_attack(config: Config) -> Optional[Attack]:
     p = config.attack.params
 
     if config.attack.type == "gaussian":
+        # "std" is the reference's alternate key for the noise scale
+        # (examples/configs/uci_har_byzantine.yaml).
         return ATTACKS["gaussian"](
             num_nodes=n,
             attack_percentage=pct,
-            noise_std=float(p.get("noise_std", 10.0)),
+            noise_std=float(p.get("noise_std", p.get("std", 10.0))),
             seed=seed,
         )
     if config.attack.type == "directed_deviation":
@@ -165,4 +167,5 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         mesh=mesh,
         seed=seed,
         donate=config.tpu.donate_state,
+        profile_dir=config.tpu.profile_dir,
     )

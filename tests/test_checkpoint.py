@@ -1,0 +1,70 @@
+"""Checkpoint/resume: an interrupted run restored from disk must produce
+bit-identical state to an uninterrupted run (no reference counterpart —
+the reference has no checkpointing, SURVEY §5)."""
+
+import jax
+import numpy as np
+
+from murmura_tpu.aggregation import build_aggregator
+from murmura_tpu.core.network import Network
+from murmura_tpu.core.rounds import build_round_program
+from murmura_tpu.data.base import stack_partitions
+from murmura_tpu.data.partitioners import iid_partition
+from murmura_tpu.data.synthetic import make_synthetic
+from murmura_tpu.models.registry import build_model
+from murmura_tpu.topology import create_topology
+from murmura_tpu.utils.checkpoint import has_checkpoint
+
+
+def _make_network(seed=0):
+    n, rounds = 4, 6
+    x, y = make_synthetic(num_samples=200, input_shape=(8,), num_classes=3, seed=seed)
+    parts = iid_partition(len(y), n, seed=seed)
+    data = stack_partitions(x, y, parts, num_classes=3)
+    model = build_model("mlp", {"input_dim": 8, "hidden_dims": [16], "num_classes": 3})
+    agg = build_aggregator("balance", {}, total_rounds=rounds)
+    program = build_round_program(
+        model, agg, data, local_epochs=1, batch_size=16, lr=0.1,
+        total_rounds=rounds, seed=seed,
+    )
+    return Network(program, create_topology("ring", num_nodes=n), seed=seed,
+                   donate=False)
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    ckpt = tmp_path / "ckpt"
+
+    # Uninterrupted: 6 rounds straight.
+    full = _make_network()
+    full.train(rounds=6)
+
+    # Interrupted: 3 rounds, checkpoint, fresh network, restore, 3 more.
+    first = _make_network()
+    first.train(rounds=3, checkpoint_dir=str(ckpt))
+    assert has_checkpoint(ckpt)
+
+    resumed = _make_network()
+    assert resumed.restore_checkpoint(str(ckpt)) == 3
+    resumed.train(rounds=3)
+
+    for a, b in zip(
+        jax.tree_util.tree_leaves(full.params),
+        jax.tree_util.tree_leaves(resumed.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for k in full.agg_state:
+        np.testing.assert_array_equal(
+            np.asarray(full.agg_state[k]), np.asarray(resumed.agg_state[k]), err_msg=k
+        )
+    assert full.history["round"] == resumed.history["round"]
+    np.testing.assert_allclose(
+        full.history["mean_accuracy"], resumed.history["mean_accuracy"]
+    )
+
+
+def test_round_counter_persists_across_train_calls():
+    net = _make_network()
+    net.train(rounds=2)
+    net.train(rounds=2)
+    assert net.current_round == 4
+    assert net.history["round"] == [1, 2, 3, 4]

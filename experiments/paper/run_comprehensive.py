@@ -1,0 +1,140 @@
+#!/usr/bin/env python3
+"""Run the paper experiment matrix and summarize results
+(reference: experiments/paper/run_comprehensive.py:1-40).
+
+Improvement over the reference: the CLI writes history JSON directly
+(`murmura run cfg -o out.json`), so results are read structurally instead of
+regex-scraping stdout (reference: run_comprehensive.py:58-69).
+
+Usage:
+    python experiments/paper/run_comprehensive.py                  # everything
+    python experiments/paper/run_comprehensive.py --category attacks
+    python experiments/paper/run_comprehensive.py --dataset uci_har
+    python experiments/paper/run_comprehensive.py --summary-only
+"""
+
+import argparse
+import json
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+PAPER_DIR = Path(__file__).parent
+CONFIG_DIR = PAPER_DIR / "configs"
+RESULTS_DIR = PAPER_DIR / "results"
+CATEGORIES = ["baseline", "heterogeneity", "attacks", "topologies", "ablation"]
+
+
+def run_one(cfg_path: Path, out_json: Path, timeout: float) -> dict:
+    """Run one experiment through the CLI; returns a result record."""
+    t0 = time.time()
+    record = {"config": str(cfg_path.relative_to(CONFIG_DIR))}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "murmura_tpu", "run", str(cfg_path),
+             "-o", str(out_json), "--quiet"],
+            capture_output=True,
+            text=True,
+            timeout=timeout,
+            cwd=PAPER_DIR.parent.parent,
+        )
+    except subprocess.TimeoutExpired:
+        record.update(ok=False, error=f"timeout after {timeout}s",
+                      wall_s=round(time.time() - t0, 1))
+        return record
+    record.update(ok=proc.returncode == 0, wall_s=round(time.time() - t0, 1))
+    if proc.returncode != 0:
+        record["error"] = proc.stderr[-2000:]
+        return record
+
+    hist = json.loads(out_json.read_text())
+    acc = hist.get("mean_accuracy", [])
+    record.update(
+        final_accuracy=acc[-1] if acc else None,
+        peak_accuracy=max(acc) if acc else None,
+        final_std=hist.get("std_accuracy", [None])[-1],
+        honest_accuracy=(hist.get("honest_accuracy") or [None])[-1],
+        rounds=len(acc),
+    )
+    if hist.get("mean_vacuity"):
+        record["final_vacuity"] = hist["mean_vacuity"][-1]
+    return record
+
+
+def summarize(records: list) -> str:
+    """RESULTS_SUMMARY.md: final accuracy per dataset x algorithm per
+    category (reference: experiments/paper/RESULTS_SUMMARY.md)."""
+    lines = ["# Results summary", ""]
+    by_cat = {}
+    for r in records:
+        if not r.get("ok"):
+            continue
+        cat = r["config"].split("/", 1)[0]
+        by_cat.setdefault(cat, []).append(r)
+    for cat in CATEGORIES:
+        if cat not in by_cat:
+            continue
+        lines += [f"## {cat}", "", "| config | final acc | peak acc | honest acc |",
+                  "|---|---|---|---|"]
+        for r in sorted(by_cat[cat], key=lambda r: r["config"]):
+            fmt = lambda v: f"{v:.4f}" if isinstance(v, float) else "—"
+            lines.append(
+                f"| {Path(r['config']).stem} | {fmt(r['final_accuracy'])} "
+                f"| {fmt(r['peak_accuracy'])} | {fmt(r.get('honest_accuracy'))} |"
+            )
+        lines.append("")
+    failed = [r for r in records if not r.get("ok")]
+    if failed:
+        lines += ["## Failures", ""] + [f"- {r['config']}" for r in failed]
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--category", choices=CATEGORIES, default=None)
+    ap.add_argument("--dataset", default=None,
+                    help="Substring filter on config names")
+    ap.add_argument("--summary-only", action="store_true")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--limit", type=int, default=None,
+                    help="Run at most N configs (smoke testing)")
+    args = ap.parse_args()
+
+    RESULTS_DIR.mkdir(parents=True, exist_ok=True)
+    results_file = RESULTS_DIR / "results.json"
+    records = (
+        json.loads(results_file.read_text()) if results_file.exists() else []
+    )
+
+    if not args.summary_only:
+        if not CONFIG_DIR.exists():
+            sys.exit("No configs found — run generate_all_configs.py first")
+        cfgs = sorted(CONFIG_DIR.glob("**/*.yaml"))
+        if args.category:
+            cfgs = [c for c in cfgs if c.parent.name == args.category]
+        if args.dataset:
+            cfgs = [c for c in cfgs if args.dataset in c.name]
+        if args.limit:
+            cfgs = cfgs[: args.limit]
+        done = {r["config"] for r in records if r.get("ok")}
+        for i, cfg in enumerate(cfgs):
+            rel = str(cfg.relative_to(CONFIG_DIR))
+            if rel in done:
+                continue
+            out = RESULTS_DIR / "histories" / rel.replace("/", "_").replace(
+                ".yaml", ".json"
+            )
+            out.parent.mkdir(parents=True, exist_ok=True)
+            print(f"[{i + 1}/{len(cfgs)}] {rel}", flush=True)
+            records = [r for r in records if r["config"] != rel]
+            records.append(run_one(cfg, out, args.timeout))
+            results_file.write_text(json.dumps(records, indent=2))
+
+    (PAPER_DIR / "RESULTS_SUMMARY.md").write_text(summarize(records))
+    ok = sum(1 for r in records if r.get("ok"))
+    print(f"{ok}/{len(records)} experiments ok; summary in RESULTS_SUMMARY.md")
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,15 @@ from murmura_tpu.data.base import FederatedArrays, stack_partitions
 from murmura_tpu.data.synthetic import make_synthetic, make_synthetic_sequences
 
 FEMNIST_CLASSES = 62
-SHAKESPEARE_VOCAB = 81
+
+# LEAF's fixed 80-char alphabet (reference: leaf/models/utils/
+# language_utils.py:11); chars outside it map to index 80, hence vocab 81
+# (LEAF itself folds unknowns onto the last position via str.find -> -1).
+SHAKESPEARE_ALPHABET = (
+    "\n !\"&'(),-.0123456789:;>?ABCDEFGHIJKLMNOPQRSTUVWXYZ[]"
+    "abcdefghijklmnopqrstuvwxyz}"
+)
+SHAKESPEARE_VOCAB = len(SHAKESPEARE_ALPHABET) + 1  # 81
 
 
 def _load_leaf_json_dir(split_dir: Path) -> Tuple[List[str], Dict[str, Dict]]:
@@ -75,6 +83,101 @@ def _femnist_from_json(
     )
 
 
+def _celeba_from_json(
+    data_path: Path,
+    num_nodes: int,
+    seed: int,
+    max_samples: Optional[int],
+    params: Dict[str, Any],
+) -> FederatedArrays:
+    """CelebA: JSON shards hold per-celebrity image filenames + binary
+    labels; pixels come from raw/img_align_celeba, resized to
+    image_size x image_size RGB in [0, 1], NHWC for TPU convs
+    (reference semantics: examples/leaf/datasets.py:96-199, which emits CHW
+    for torch)."""
+    from PIL import Image
+
+    image_size = int(params.get("image_size", 84))
+    users, user_data = _load_leaf_json_dir(data_path / "train")
+    groups = _round_robin_users(users, num_nodes, seed)
+    images_dir = Path(params.get("image_dir", data_path / "raw" / "img_align_celeba"))
+
+    xs, ys = [], []
+    offsets: Dict[str, Tuple[int, int]] = {}
+    cursor = 0
+    for u in users:
+        fnames = user_data[u]["x"]
+        uy = np.asarray(user_data[u]["y"], dtype=np.int32)
+        if max_samples is not None:
+            # Per-node truncation happens in stack_partitions; capping each
+            # user here too keeps full-dataset decode memory bounded
+            # (~85 KB/image x 200k images otherwise).
+            fnames = fnames[:max_samples]
+            uy = uy[:max_samples]
+        ux = np.empty((len(fnames), image_size, image_size, 3), np.float32)
+        for i, name in enumerate(fnames):
+            p = images_dir / name
+            if not p.exists():
+                p = images_dir.parent / name  # raw/<name> fallback
+            img = Image.open(p).resize((image_size, image_size)).convert("RGB")
+            ux[i] = np.asarray(img, dtype=np.float32) / 255.0
+        xs.append(ux)
+        ys.append(uy)
+        offsets[u] = (cursor, cursor + len(uy))
+        cursor += len(uy)
+
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    partitions = [
+        [i for u in group for i in range(*offsets[u])] for group in groups
+    ]
+    return stack_partitions(x, y, partitions, max_samples=max_samples, num_classes=2)
+
+
+def _shakespeare_from_json(
+    data_path: Path, num_nodes: int, seed: int, max_samples: Optional[int]
+) -> FederatedArrays:
+    """Shakespeare next-char prediction: JSON x = 80-char contexts,
+    y = next char, one user per role; chars indexed by the fixed LEAF
+    alphabet with unknowns -> index 80 (reference layout:
+    leaf/data/shakespeare; vocab: leaf/models/utils/language_utils.py:11)."""
+    lut = np.full(256, len(SHAKESPEARE_ALPHABET), dtype=np.int32)
+    for i, ch in enumerate(SHAKESPEARE_ALPHABET):
+        lut[ord(ch)] = i
+
+    def encode(strings) -> np.ndarray:
+        buf = np.frombuffer(
+            "".join(strings).encode("latin1", errors="replace"), dtype=np.uint8
+        )
+        return lut[buf].reshape(len(strings), -1)
+
+    users, user_data = _load_leaf_json_dir(data_path / "train")
+    groups = _round_robin_users(users, num_nodes, seed)
+
+    xs, ys = [], []
+    offsets: Dict[str, Tuple[int, int]] = {}
+    cursor = 0
+    for u in users:
+        ux = encode(user_data[u]["x"])
+        y_chars = "".join(c[0] if c else "\0" for c in user_data[u]["y"])
+        uy = lut[
+            np.frombuffer(y_chars.encode("latin1", errors="replace"), np.uint8)
+        ].astype(np.int32)
+        xs.append(ux)
+        ys.append(uy)
+        offsets[u] = (cursor, cursor + len(uy))
+        cursor += len(uy)
+
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    partitions = [
+        [i for u in group for i in range(*offsets[u])] for group in groups
+    ]
+    return stack_partitions(
+        x, y, partitions, max_samples=max_samples, num_classes=SHAKESPEARE_VOCAB
+    )
+
+
 def load_leaf_federated(
     dataset: str,
     params: Dict[str, Any],
@@ -96,10 +199,11 @@ def load_leaf_federated(
             )
         if dataset == "femnist":
             return _femnist_from_json(root, num_nodes, seed, max_samples)
-        raise NotImplementedError(
-            f"On-disk loading for leaf.{dataset} not implemented yet; "
-            "use synthetic: true"
-        )
+        if dataset == "celeba":
+            return _celeba_from_json(root, num_nodes, seed, max_samples, params)
+        if dataset == "shakespeare":
+            return _shakespeare_from_json(root, num_nodes, seed, max_samples)
+        raise ValueError(f"Unknown LEAF dataset: {dataset}")
 
     # ---- synthetic, shape-identical fallbacks ----------------------------
     n_total = int(params.get("num_samples", max(2000, 200 * num_nodes)))

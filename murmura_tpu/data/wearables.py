@@ -21,11 +21,26 @@ from murmura_tpu.data.partitioners import (
 from murmura_tpu.data.synthetic import make_synthetic
 
 # (input_dim, num_classes, num_subjects) — reference: wearables/datasets.py
+# and models.py:195-300 (UCI HAR 561; PAMAP2 100-sample window x 40 features;
+# PPG-DaLiA 32-sample window x 6 features).
 WEARABLE_SPECS = {
     "uci_har": (561, 6, 30),
-    "pamap2": (243, 12, 9),
-    "ppg_dalia": (16, 7, 15),
+    "pamap2": (4000, 12, 9),
+    "ppg_dalia": (192, 7, 15),
 }
+
+# PAMAP2 protocol-file layout (reference: wearables/datasets.py:117-126):
+# col 0 timestamp, 1 activity, 2 heart rate; IMUs (hand/chest/ankle) start at
+# 3/20/37, 17 cols each; the first 13 per IMU (temp + accel16g + accel6g +
+# gyro + mag) are valid features, the trailing 4 orientation cols are not.
+PAMAP2_ACTIVITIES = [1, 2, 3, 4, 5, 6, 7, 12, 13, 16, 17, 24]
+PAMAP2_IMU_STARTS = (3, 20, 37)
+PAMAP2_HEART_RATE_COL = 2
+PAMAP2_ACTIVITY_COL = 1
+
+# PPG-DaLiA wrist-sensor rates (reference: wearables/datasets.py:333-340):
+# ACC 32 Hz, BVP 64 Hz, EDA/TEMP 4 Hz; labels at 4 Hz.
+PPG_ACTIVITIES = [1, 2, 3, 4, 5, 6, 7]
 
 
 def _load_uci_har(root: Path, split: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -36,6 +51,143 @@ def _load_uci_har(root: Path, split: str) -> Tuple[np.ndarray, np.ndarray, np.nd
     y = np.loadtxt(d / f"y_{split}.txt", dtype=np.int32) - 1  # 1-based -> 0-based
     subjects = np.loadtxt(d / f"subject_{split}.txt", dtype=np.int32)
     return x, y, subjects
+
+
+def _nan_to_column_mean(features: np.ndarray) -> np.ndarray:
+    """Replace NaNs with the column mean, or 0 where a column is all-NaN
+    (reference: wearables/datasets.py:233-244)."""
+    col_mean = np.nanmean(
+        np.where(np.isnan(features).all(0), 0.0, features), axis=0
+    )
+    col_mean = np.nan_to_num(col_mean, nan=0.0)
+    return np.where(np.isnan(features), col_mean[None, :], features)
+
+
+def _majority_windows(
+    features: np.ndarray,
+    activities: np.ndarray,
+    window: int,
+    stride: int,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Sliding windows with majority-activity labels, vectorized.
+
+    The reference loops per window and takes the np.unique argmax (smallest
+    activity id wins ties — wearables/datasets.py:246-275); a 2-D bincount
+    over window rows reproduces that tie-break exactly.
+    Returns (flattened windows [W, window*F], majority activity ids [W]).
+    """
+    num = len(features)
+    if num < window:
+        return (
+            np.empty((0, window * features.shape[1]), np.float32),
+            np.empty((0,), np.int64),
+        )
+    n_win = (num - window) // stride + 1
+    idx = np.arange(n_win)[:, None] * stride + np.arange(window)[None, :]
+    flat = features[idx].reshape(n_win, -1).astype(np.float32)
+
+    acts = activities[idx]  # [W, window] of small non-negative ints
+    n_ids = int(acts.max()) + 1
+    counts = np.zeros((n_win, n_ids), np.int64)
+    np.add.at(counts, (np.arange(n_win)[:, None], acts), 1)
+    return flat, counts.argmax(axis=1)
+
+
+def _zscore(x: np.ndarray) -> np.ndarray:
+    """Per-column standardization with zero-std guard
+    (reference: wearables/datasets.py:277-282)."""
+    mean = x.mean(axis=0)
+    std = x.std(axis=0)
+    std[std == 0] = 1.0
+    return (x - mean) / std
+
+
+def _load_pamap2(
+    root: Path, params: Dict[str, Any]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """PAMAP2: per-subject protocol files -> activity-filtered rows ->
+    NaN fill -> sliding windows with majority labels -> global z-score
+    (reference: wearables/datasets.py:92-301)."""
+    window = int(params.get("window_size", 100))
+    stride = int(params.get("window_stride", 50))
+    include_hr = bool(params.get("include_heart_rate", True))
+    normalize = bool(params.get("normalize", True))
+    activities = list(params.get("activities", PAMAP2_ACTIVITIES))
+    subjects = list(params.get("subjects", range(101, 110)))
+    act_to_idx = {a: i for i, a in enumerate(activities)}
+
+    cols = ([PAMAP2_HEART_RATE_COL] if include_hr else []) + [
+        c for start in PAMAP2_IMU_STARTS for c in range(start, start + 13)
+    ]
+
+    xs, ys, subs = [], [], []
+    for sid in subjects:
+        f = root / "Protocol" / f"subject{sid}.dat"
+        if not f.exists():
+            continue
+        raw = np.loadtxt(f)
+        act = raw[:, PAMAP2_ACTIVITY_COL].astype(np.int64)
+        keep = np.isin(act, activities)
+        feats = _nan_to_column_mean(raw[keep][:, cols])
+        win, maj = _majority_windows(feats, act[keep], window, stride)
+        if len(win):
+            xs.append(win)
+            ys.append(np.array([act_to_idx[a] for a in maj], np.int32))
+            subs.append(np.full(len(win), sid, np.int32))
+
+    if not xs:
+        raise ValueError(f"No PAMAP2 data under {root}")
+    x = np.vstack(xs)
+    if normalize:
+        x = _zscore(x)
+    return x.astype(np.float32), np.concatenate(ys), np.concatenate(subs)
+
+
+def _load_ppg_dalia(
+    root: Path, params: Dict[str, Any]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """PPG-DaLiA: per-subject pickles -> wrist signals downsampled to the
+    4 Hz label rate -> [EDA, TEMP, ACC xyz, BVP] stack -> activity filter ->
+    windows -> global z-score (reference: wearables/datasets.py:304-531)."""
+    import pickle
+
+    window = int(params.get("window_size", 32))
+    stride = int(params.get("window_stride", 16))
+    normalize = bool(params.get("normalize", True))
+    activities = list(params.get("activities", PPG_ACTIVITIES))
+    subjects = list(params.get("subjects", range(1, 16)))
+    act_to_idx = {a: i for i, a in enumerate(activities)}
+
+    xs, ys, subs = [], [], []
+    for sid in subjects:
+        f = root / f"S{sid}" / f"S{sid}.pkl"
+        if not f.exists():
+            continue
+        with open(f, "rb") as fh:
+            blob = pickle.load(fh, encoding="latin1")
+        wrist = blob["signal"]["wrist"]
+        eda = np.asarray(wrist["EDA"]).reshape(-1)  # native 4 Hz
+        temp = np.asarray(wrist["TEMP"]).reshape(-1)  # native 4 Hz
+        acc = np.asarray(wrist["ACC"])[::8, :]  # 32 Hz -> 4 Hz
+        bvp = np.asarray(wrist["BVP"]).reshape(-1)[::16]  # 64 Hz -> 4 Hz
+        act = np.asarray(blob["activity"]).reshape(-1).astype(np.int64)
+
+        m = min(len(eda), len(temp), len(acc), len(bvp), len(act))
+        feats = np.column_stack([eda[:m], temp[:m], acc[:m], bvp[:m]])
+        feats = np.nan_to_num(feats, nan=0.0).astype(np.float32)
+        keep = np.isin(act[:m], activities)
+        win, maj = _majority_windows(feats[keep], act[:m][keep], window, stride)
+        if len(win):
+            xs.append(win)
+            ys.append(np.array([act_to_idx[a] for a in maj], np.int32))
+            subs.append(np.full(len(win), sid, np.int32))
+
+    if not xs:
+        raise ValueError(f"No PPG-DaLiA data under {root}")
+    x = np.vstack(xs)
+    if normalize:
+        x = _zscore(x)
+    return x.astype(np.float32), np.concatenate(ys), np.concatenate(subs)
 
 
 def load_wearable_federated(
@@ -52,15 +204,22 @@ def load_wearable_federated(
     data_path = params.get("data_path")
     split = params.get("split", "train")
 
+    # The synthetic fallback mirrors the on-disk feature dimensionality,
+    # including non-default window params (window_size x features/step).
+    if dataset == "pamap2":
+        feats = (1 if params.get("include_heart_rate", True) else 0) + 39
+        input_dim = int(params.get("window_size", 100)) * feats
+    elif dataset == "ppg_dalia":
+        input_dim = int(params.get("window_size", 32)) * 6
+
     x = y = subjects = None
     if data_path and Path(data_path).exists():
         if dataset == "uci_har":
             x, y, subjects = _load_uci_har(Path(data_path), split)
-        else:
-            raise NotImplementedError(
-                f"On-disk loading for wearables.{dataset} not implemented yet; "
-                "omit data_path for synthetic data"
-            )
+        elif dataset == "pamap2":
+            x, y, subjects = _load_pamap2(Path(data_path), params)
+        elif dataset == "ppg_dalia":
+            x, y, subjects = _load_ppg_dalia(Path(data_path), params)
 
     if x is None:
         n_total = int(params.get("num_samples", max(2000, 300 * num_nodes)))

@@ -12,11 +12,13 @@ from murmura_tpu.models.core import Model
 from murmura_tpu.models.lstm import make_char_lstm
 from murmura_tpu.models.mlp import make_mlp, make_wearable_mlp
 
-# Wearable dataset default dims (reference: murmura/examples/wearables/models.py:355-481)
+# Wearable dataset default dims (reference: murmura/examples/wearables/models.py:195-300:
+# UCI HAR 561/(256,128); PAMAP2 4000 = 100-window x 40 feats /(512,256,128);
+# PPG-DaLiA 192 = 32-window x 6 feats /(256,128,64))
 _WEARABLE_DEFAULTS = {
     "uci_har": {"input_dim": 561, "hidden_dims": (256, 128), "num_classes": 6},
-    "pamap2": {"input_dim": 243, "hidden_dims": (256, 128), "num_classes": 12},
-    "ppg_dalia": {"input_dim": 16, "hidden_dims": (128, 64), "num_classes": 7},
+    "pamap2": {"input_dim": 4000, "hidden_dims": (512, 256, 128), "num_classes": 12},
+    "ppg_dalia": {"input_dim": 192, "hidden_dims": (256, 128, 64), "num_classes": 7},
 }
 
 

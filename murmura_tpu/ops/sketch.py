@@ -30,9 +30,26 @@ def count_sketch(
     hash_table: jnp.ndarray,
     sign_table: jnp.ndarray,
     sketch_size: int,
+    use_pallas: "bool | None" = None,
 ) -> jnp.ndarray:
     """Compress a [P] vector to a [sketch_size] Count-Sketch
-    (reference: sketchguard.py:91-112)."""
+    (reference: sketchguard.py:91-112).
+
+    On TPU this dispatches to the Pallas MXU kernel
+    (ops/pallas_sketch.py) — XLA lowers segment_sum with random indices
+    to a serialized scatter, the one non-vectorizing op in the
+    Sketchguard round.  Elsewhere (CPU tests) it stays a segment_sum.
+    """
+    if use_pallas is None:
+        from murmura_tpu.ops.pallas_sketch import MAX_SKETCH_PAD
+
+        use_pallas = (
+            jax.default_backend() == "tpu" and sketch_size <= MAX_SKETCH_PAD
+        )
+    if use_pallas:
+        from murmura_tpu.ops.pallas_sketch import count_sketch_pallas
+
+        return count_sketch_pallas(vector, hash_table, sign_table, sketch_size)
     return jax.ops.segment_sum(
         sign_table * vector, hash_table, num_segments=sketch_size
     )

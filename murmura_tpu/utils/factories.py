@@ -89,7 +89,6 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
     seed = config.experiment.seed
     rounds = config.experiment.rounds
 
-    model = build_model(config.model.factory, config.model.params)
     data = build_federated_data(
         config.data.adapter,
         config.data.params,
@@ -97,6 +96,17 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         seed=seed,
         max_samples=config.training.max_samples,
     )
+    model_params = dict(config.model.params)
+    if (
+        "wearables." in config.model.factory
+        and "input_dim" not in model_params
+        and data.x.ndim == 3
+    ):
+        # Window params on the data side (window_size, include_heart_rate)
+        # change the sample dimensionality; keep the model input in sync
+        # unless the user pinned it explicitly.
+        model_params["input_dim"] = int(data.x.shape[-1])
+    model = build_model(config.model.factory, model_params)
 
     topology = create_topology(
         config.topology.type,

@@ -1,0 +1,73 @@
+"""simulation vs tpu backend equivalence: the same config, seed, and round
+count must learn the same way whether the node axis is vmapped on one
+device or sharded over the 8-virtual-device CPU mesh
+(SURVEY.md §4 test plan items (b)/(c))."""
+
+import numpy as np
+
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+
+
+def _cfg(backend: str) -> Config:
+    return Config.model_validate(
+        {
+            "experiment": {"name": f"eq-{backend}", "seed": 11, "rounds": 3},
+            "topology": {"type": "ring", "num_nodes": 8},
+            "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+            "attack": {"enabled": True, "type": "gaussian", "percentage": 0.25,
+                        "params": {"noise_std": 5.0}},
+            "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+            "data": {"adapter": "synthetic",
+                     "params": {"num_samples": 800, "input_dim": 24,
+                                "num_classes": 4}},
+            "model": {"factory": "mlp",
+                      "params": {"input_dim": 24, "hidden_dims": [32],
+                                 "num_classes": 4}},
+            "backend": backend,
+        }
+    )
+
+
+def test_simulation_and_tpu_backends_match():
+    hist_sim = build_network_from_config(_cfg("simulation")).train(rounds=3)
+    hist_tpu = build_network_from_config(_cfg("tpu")).train(rounds=3)
+
+    assert hist_sim["round"] == hist_tpu["round"]
+    np.testing.assert_allclose(
+        hist_sim["mean_accuracy"], hist_tpu["mean_accuracy"], atol=1e-4
+    )
+    np.testing.assert_allclose(
+        hist_sim["mean_loss"], hist_tpu["mean_loss"], rtol=1e-3, atol=1e-4
+    )
+    np.testing.assert_allclose(
+        hist_sim["honest_accuracy"], hist_tpu["honest_accuracy"], atol=1e-4
+    )
+
+
+def test_tpu_backend_learns_under_attack():
+    net = build_network_from_config(_cfg("tpu"))
+    hist = net.train(rounds=3)
+    assert hist["honest_accuracy"][-1] > 0.5  # Krum resists 25% gaussian
+
+
+def test_wearable_window_params_sync_model_input_dim():
+    # Non-default window params change sample dimensionality; the model
+    # input must follow without a hand-set input_dim.
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": "win-sync", "seed": 0, "rounds": 1},
+            "topology": {"type": "ring", "num_nodes": 4},
+            "aggregation": {"algorithm": "fedavg", "params": {}},
+            "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+            "data": {"adapter": "wearables.pamap2",
+                     "params": {"window_size": 50,
+                                "include_heart_rate": False,
+                                "num_samples": 200,
+                                "partition_method": "iid"}},
+            "model": {"factory": "examples.wearables.pamap2", "params": {}},
+            "backend": "simulation",
+        }
+    )
+    hist = build_network_from_config(cfg).train(rounds=1)
+    assert len(hist["round"]) == 1  # forward pass shape-consistent

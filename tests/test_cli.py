@@ -1,0 +1,55 @@
+"""CLI surface via click's test runner (reference: murmura/cli.py:34-308)."""
+
+import json
+
+import yaml
+from click.testing import CliRunner
+
+from murmura_tpu.cli import app
+
+
+def _write_cfg(tmp_path, **overrides):
+    cfg = {
+        "experiment": {"name": "cli-test", "seed": 3, "rounds": 2},
+        "topology": {"type": "ring", "num_nodes": 4},
+        "aggregation": {"algorithm": "fedavg", "params": {}},
+        "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.1},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 200, "input_dim": 8,
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 8, "hidden_dims": [16],
+                             "num_classes": 3}},
+        "backend": "simulation",
+    }
+    cfg.update(overrides)
+    p = tmp_path / "cfg.yaml"
+    p.write_text(yaml.safe_dump(cfg))
+    return p
+
+
+def test_run_writes_history_json(tmp_path):
+    cfg = _write_cfg(tmp_path)
+    out = tmp_path / "hist.json"
+    result = CliRunner().invoke(app, ["run", str(cfg), "-o", str(out)])
+    assert result.exit_code == 0, result.output
+    hist = json.loads(out.read_text())
+    # Reference history schema (murmura/core/network.py:47-58).
+    for key in ("round", "mean_accuracy", "std_accuracy", "mean_loss"):
+        assert key in hist
+    assert hist["round"] == [1, 2]
+
+
+def test_run_resume_requires_checkpoint_dir(tmp_path):
+    cfg = _write_cfg(tmp_path)
+    result = CliRunner().invoke(app, ["run", str(cfg), "--resume"])
+    assert result.exit_code != 0
+    assert "--checkpoint-dir" in result.output
+
+
+def test_list_components():
+    result = CliRunner().invoke(app, ["list-components"])
+    assert result.exit_code == 0
+    for frag in ("fedavg", "krum", "evidential_trust", "gaussian",
+                 "simulation", "ring"):
+        assert frag in result.output

@@ -1,0 +1,189 @@
+"""On-disk dataset loaders against tiny synthetic fixtures
+(reference semantics: murmura/examples/wearables/datasets.py,
+murmura/examples/leaf/datasets.py)."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from murmura_tpu.data.leaf import (
+    SHAKESPEARE_ALPHABET,
+    SHAKESPEARE_VOCAB,
+    load_leaf_federated,
+)
+from murmura_tpu.data.wearables import (
+    _majority_windows,
+    load_wearable_federated,
+)
+
+
+def test_majority_windows_tie_break_matches_reference():
+    # Reference takes np.unique + argmax: smallest activity id wins ties
+    # (wearables/datasets.py:246-275).
+    feats = np.arange(8, dtype=np.float32).reshape(4, 2)
+    acts = np.array([5, 2, 2, 5])  # tie 2-2 in the single window
+    win, maj = _majority_windows(feats, acts, window=4, stride=4)
+    assert win.shape == (1, 8)
+    assert maj.tolist() == [2]
+
+
+def test_majority_windows_stride_and_count():
+    feats = np.zeros((10, 3), np.float32)
+    acts = np.ones(10, np.int64)
+    win, maj = _majority_windows(feats, acts, window=4, stride=2)
+    assert win.shape == (4, 12)  # starts 0,2,4,6
+    assert (maj == 1).all()
+    win, _ = _majority_windows(feats[:3], acts[:3], window=4, stride=2)
+    assert win.shape == (0, 12)  # shorter than one window
+
+
+@pytest.fixture
+def pamap2_dir(tmp_path):
+    rng = np.random.default_rng(0)
+    d = tmp_path / "PAMAP2_Dataset" / "Protocol"
+    d.mkdir(parents=True)
+    rows = 400
+    data = rng.normal(size=(rows, 54))
+    data[:, 0] = np.arange(rows) * 0.01  # timestamp
+    data[:, 1] = np.where(np.arange(rows) < 200, 1, 4)  # lying then walking
+    data[50:60, 2] = np.nan  # heart-rate dropouts
+    data[100:110, 5] = np.nan
+    np.savetxt(d / "subject101.dat", data)
+    return tmp_path / "PAMAP2_Dataset"
+
+
+def test_pamap2_loader(pamap2_dir):
+    fa = load_wearable_federated(
+        "pamap2",
+        {"data_path": str(pamap2_dir), "window_size": 100, "window_stride": 50,
+         "partition_method": "iid"},
+        num_nodes=2,
+        seed=0,
+    )
+    # 400 valid rows -> starts 0,50,...,300 = 7 windows, 40 feats * 100.
+    assert int(fa.num_samples.sum()) == 7
+    assert fa.x.shape[-1] == 4000
+    assert not np.isnan(fa.x).any()  # NaNs replaced by column means
+    assert fa.num_classes == 12
+    # Labels: activity 1 -> idx 0, activity 4 -> idx 3.
+    valid_labels = fa.y[fa.mask.astype(bool)]
+    assert set(valid_labels.tolist()) <= {0, 3}
+
+
+def test_pamap2_window_params_change_dim(pamap2_dir):
+    fa = load_wearable_federated(
+        "pamap2",
+        {"data_path": str(pamap2_dir), "window_size": 50, "window_stride": 25,
+         "include_heart_rate": False, "partition_method": "iid"},
+        num_nodes=2,
+        seed=0,
+    )
+    assert fa.x.shape[-1] == 50 * 39
+
+
+@pytest.fixture
+def ppg_dir(tmp_path):
+    rng = np.random.default_rng(1)
+    secs = 120
+    for sid in (1, 2):
+        d = tmp_path / f"S{sid}"
+        d.mkdir(parents=True)
+        blob = {
+            "signal": {
+                "wrist": {
+                    "EDA": rng.normal(size=(secs * 4, 1)),
+                    "TEMP": rng.normal(size=(secs * 4, 1)),
+                    "ACC": rng.normal(size=(secs * 32, 3)),
+                    "BVP": rng.normal(size=(secs * 64, 1)),
+                }
+            },
+            "activity": np.repeat([1, 4], secs * 2).reshape(-1, 1).astype(float),
+        }
+        with open(d / f"S{sid}.pkl", "wb") as f:
+            pickle.dump(blob, f)
+    return tmp_path
+
+
+def test_ppg_dalia_loader(ppg_dir):
+    fa = load_wearable_federated(
+        "ppg_dalia",
+        {"data_path": str(ppg_dir), "partition_method": "iid"},
+        num_nodes=2,
+        seed=0,
+    )
+    assert fa.x.shape[-1] == 32 * 6  # 192, the reference model default
+    assert fa.num_classes == 7
+    # 480 label steps per subject -> (480-32)//16+1 = 29 windows x 2 subjects.
+    assert int(fa.num_samples.sum()) == 58
+    valid_labels = fa.y[fa.mask.astype(bool)]
+    assert set(valid_labels.tolist()) <= {0, 3}  # activities 1 and 4
+
+
+@pytest.fixture
+def shakespeare_dir(tmp_path):
+    d = tmp_path / "shakespeare" / "train"
+    d.mkdir(parents=True)
+    ctx = "to be or not to be that is the question".ljust(80, "X")
+    assert len(ctx) == 80
+    blob = {
+        "users": ["hamlet", "ophelia"],
+        "num_samples": [3, 2],
+        "user_data": {
+            "hamlet": {"x": [ctx] * 3, "y": ["a", "b", "~"]},  # ~ not in alphabet
+            "ophelia": {"x": [ctx] * 2, "y": ["c", " "]},
+        },
+    }
+    (d / "all_data_0.json").write_text(json.dumps(blob))
+    return tmp_path / "shakespeare"
+
+
+def test_shakespeare_loader(shakespeare_dir):
+    fa = load_leaf_federated(
+        "shakespeare", {"data_path": str(shakespeare_dir)}, num_nodes=2, seed=0
+    )
+    assert fa.x.shape[-1] == 80
+    assert fa.num_classes == SHAKESPEARE_VOCAB
+    valid = fa.mask.astype(bool)
+    assert int(fa.num_samples.sum()) == 5
+    # '~' is outside the LEAF alphabet -> unknown index 80.
+    assert 80 in fa.y[valid].tolist()
+    a_idx = SHAKESPEARE_ALPHABET.index("a")
+    assert a_idx in fa.y[valid].tolist()
+
+
+@pytest.fixture
+def celeba_dir(tmp_path):
+    from PIL import Image
+
+    root = tmp_path / "celeba"
+    (root / "train").mkdir(parents=True)
+    img_dir = root / "raw" / "img_align_celeba"
+    img_dir.mkdir(parents=True)
+    rng = np.random.default_rng(2)
+    names = [f"img_{i}.jpg" for i in range(6)]
+    for nm in names:
+        Image.fromarray(
+            rng.integers(0, 255, size=(109, 89, 3), dtype=np.uint8)
+        ).save(img_dir / nm)
+    blob = {
+        "users": ["celeb_a", "celeb_b"],
+        "num_samples": [4, 2],
+        "user_data": {
+            "celeb_a": {"x": names[:4], "y": [0, 1, 0, 1]},
+            "celeb_b": {"x": names[4:], "y": [1, 0]},
+        },
+    }
+    (root / "train" / "all_data_0.json").write_text(json.dumps(blob))
+    return root
+
+
+def test_celeba_loader(celeba_dir):
+    fa = load_leaf_federated(
+        "celeba", {"data_path": str(celeba_dir)}, num_nodes=2, seed=0
+    )
+    assert fa.x.shape[-3:] == (84, 84, 3)  # NHWC, resized
+    assert fa.num_classes == 2
+    assert int(fa.num_samples.sum()) == 6
+    assert fa.x.max() <= 1.0 and fa.x.min() >= 0.0

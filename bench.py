@@ -43,6 +43,10 @@ def main():
                 },
             },
             "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
+            # Single-chip mesh; bfloat16 matmul/conv inputs on the MXU with
+            # float32 params/accumulation (models/core.py mixed precision).
+            "backend": "tpu",
+            "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
         }
     )
 

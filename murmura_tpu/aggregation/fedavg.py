@@ -3,17 +3,35 @@
 
 Vectorized over the whole network: own state plus one adjacency matmul over
 the broadcast tensor, normalized by 1 + degree.
+
+``exchange_offsets`` (tpu.exchange: ppermute): on a circulant graph the
+adjacency matmul is a sum of fixed circular shifts; ``jnp.roll`` along the
+sharded node axis lowers to boundary-slice collective-permutes over ICI —
+O(degree) bytes per device instead of the all-gathered [N, P] tensor
+(SURVEY.md §7 "use ppermute neighbor-only exchange for sparse topologies").
 """
+
+from typing import Optional, Sequence
 
 import jax.numpy as jnp
 
 from murmura_tpu.aggregation.base import AggContext, AggregatorDef
 
 
-def make_fedavg(**_params) -> AggregatorDef:
+def make_fedavg(
+    exchange_offsets: Optional[Sequence[int]] = None, **_params
+) -> AggregatorDef:
+    offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         degree = adj.sum(axis=1)
-        new_flat = (own + adj @ bcast) / (1.0 + degree)[:, None]
+        if offsets is not None:
+            # roll(bcast, -o)[i] == bcast[(i+o) % N]: node i's neighbor at
+            # circulant offset o.
+            neighbor_sum = sum(jnp.roll(bcast, -o, axis=0) for o in offsets)
+        else:
+            neighbor_sum = adj @ bcast
+        new_flat = (own + neighbor_sum) / (1.0 + degree)[:, None]
         return new_flat, state, {"num_neighbors": degree}
 
     return AggregatorDef(name="fedavg", aggregate=aggregate)

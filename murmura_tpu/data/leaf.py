@@ -56,30 +56,48 @@ def _round_robin_users(
     return groups
 
 
-def _femnist_from_json(
-    data_path: Path, num_nodes: int, seed: int, max_samples: Optional[int]
+def _stack_user_groups(
+    users: List[str],
+    groups: List[List[str]],
+    load_user,
+    num_classes: int,
+    max_samples: Optional[int],
 ) -> FederatedArrays:
-    train_users, train_data = _load_leaf_json_dir(data_path / "train")
-    groups = _round_robin_users(train_users, num_nodes, seed)
-
+    """Shared scaffolding for all LEAF loaders: decode each user via
+    ``load_user(u) -> (ux, uy)``, track sample offsets, then map the
+    round-robin user groups onto node partitions."""
     xs, ys = [], []
     offsets: Dict[str, Tuple[int, int]] = {}
     cursor = 0
-    for u in train_users:
-        ux = np.asarray(train_data[u]["x"], dtype=np.float32).reshape(-1, 28, 28, 1)
-        uy = np.asarray(train_data[u]["y"], dtype=np.int32)
+    for u in users:
+        ux, uy = load_user(u)
         xs.append(ux)
         ys.append(uy)
         offsets[u] = (cursor, cursor + len(uy))
         cursor += len(uy)
     x = np.concatenate(xs)
     y = np.concatenate(ys)
-
     partitions = [
         [i for u in group for i in range(*offsets[u])] for group in groups
     ]
     return stack_partitions(
-        x, y, partitions, max_samples=max_samples, num_classes=FEMNIST_CLASSES
+        x, y, partitions, max_samples=max_samples, num_classes=num_classes
+    )
+
+
+def _femnist_from_json(
+    data_path: Path, num_nodes: int, seed: int, max_samples: Optional[int]
+) -> FederatedArrays:
+    train_users, train_data = _load_leaf_json_dir(data_path / "train")
+    groups = _round_robin_users(train_users, num_nodes, seed)
+
+    def load_user(u):
+        ux = np.asarray(train_data[u]["x"], dtype=np.float32).reshape(-1, 28, 28, 1)
+        uy = np.asarray(train_data[u]["y"], dtype=np.int32)
+        return ux, uy
+
+    return _stack_user_groups(
+        train_users, groups, load_user, FEMNIST_CLASSES, max_samples
     )
 
 
@@ -102,10 +120,7 @@ def _celeba_from_json(
     groups = _round_robin_users(users, num_nodes, seed)
     images_dir = Path(params.get("image_dir", data_path / "raw" / "img_align_celeba"))
 
-    xs, ys = [], []
-    offsets: Dict[str, Tuple[int, int]] = {}
-    cursor = 0
-    for u in users:
+    def load_user(u):
         fnames = user_data[u]["x"]
         uy = np.asarray(user_data[u]["y"], dtype=np.int32)
         if max_samples is not None:
@@ -121,17 +136,9 @@ def _celeba_from_json(
                 p = images_dir.parent / name  # raw/<name> fallback
             img = Image.open(p).resize((image_size, image_size)).convert("RGB")
             ux[i] = np.asarray(img, dtype=np.float32) / 255.0
-        xs.append(ux)
-        ys.append(uy)
-        offsets[u] = (cursor, cursor + len(uy))
-        cursor += len(uy)
+        return ux, uy
 
-    x = np.concatenate(xs)
-    y = np.concatenate(ys)
-    partitions = [
-        [i for u in group for i in range(*offsets[u])] for group in groups
-    ]
-    return stack_partitions(x, y, partitions, max_samples=max_samples, num_classes=2)
+    return _stack_user_groups(users, groups, load_user, 2, max_samples)
 
 
 def _shakespeare_from_json(
@@ -145,36 +152,28 @@ def _shakespeare_from_json(
     for i, ch in enumerate(SHAKESPEARE_ALPHABET):
         lut[ord(ch)] = i
 
-    def encode(strings) -> np.ndarray:
-        buf = np.frombuffer(
-            "".join(strings).encode("latin1", errors="replace"), dtype=np.uint8
-        )
-        return lut[buf].reshape(len(strings), -1)
+    def encode(s: str) -> np.ndarray:
+        # Vectorized codepoint extraction; anything outside Latin-1 folds to
+        # codepoint 0 (NUL, not in the alphabet) so it lands in the unknown
+        # bucket 80 — a latin1 errors="replace" encode would instead emit
+        # '?', which IS in the alphabet, silently mislabeling those chars.
+        cp = np.frombuffer(s.encode("utf-32-le"), dtype=np.uint32)
+        return lut[np.where(cp < 256, cp, 0).astype(np.uint8)]
 
     users, user_data = _load_leaf_json_dir(data_path / "train")
     groups = _round_robin_users(users, num_nodes, seed)
 
-    xs, ys = [], []
-    offsets: Dict[str, Tuple[int, int]] = {}
-    cursor = 0
-    for u in users:
-        ux = encode(user_data[u]["x"])
-        y_chars = "".join(c[0] if c else "\0" for c in user_data[u]["y"])
-        uy = lut[
-            np.frombuffer(y_chars.encode("latin1", errors="replace"), np.uint8)
-        ].astype(np.int32)
-        xs.append(ux)
-        ys.append(uy)
-        offsets[u] = (cursor, cursor + len(uy))
-        cursor += len(uy)
+    def load_user(u):
+        ux = encode("".join(user_data[u]["x"])).reshape(
+            len(user_data[u]["x"]), -1
+        )
+        uy = encode(
+            "".join(c[0] if c else "\0" for c in user_data[u]["y"])
+        ).astype(np.int32)
+        return ux, uy
 
-    x = np.concatenate(xs)
-    y = np.concatenate(ys)
-    partitions = [
-        [i for u in group for i in range(*offsets[u])] for group in groups
-    ]
-    return stack_partitions(
-        x, y, partitions, max_samples=max_samples, num_classes=SHAKESPEARE_VOCAB
+    return _stack_user_groups(
+        users, groups, load_user, SHAKESPEARE_VOCAB, max_samples
     )
 
 

@@ -23,6 +23,7 @@ from murmura_tpu.models.core import (
     dense,
     dense_init,
     max_pool,
+    resolve_dtype,
 )
 
 FEMNIST_VARIANTS = {
@@ -41,6 +42,7 @@ def make_femnist_cnn(
     image_size: int = 28,
     channels_in: int = 1,
     name: str = None,
+    compute_dtype=None,
 ) -> Model:
     """Build a FEMNIST CNN ``Model`` for 28x28x1 inputs."""
     if variant not in FEMNIST_VARIANTS:
@@ -48,6 +50,7 @@ def make_femnist_cnn(
             f"Unknown FEMNIST variant '{variant}' (choose from {list(FEMNIST_VARIANTS)})"
         )
     conv_channels, kernel, fc_dims = FEMNIST_VARIANTS[variant]
+    cd = resolve_dtype(compute_dtype)
     # xlarge applies conv1,conv2 then pool, conv3 then pool (reference:
     # examples/leaf/models.py:159-169); others pool after every conv.
     final_hw = image_size // 4
@@ -75,18 +78,18 @@ def make_femnist_cnn(
         n_conv = len(params["convs"])
         if n_conv == 2:
             for conv_p in params["convs"]:
-                x = jax.nn.relu(conv2d(conv_p, x))
+                x = jax.nn.relu(conv2d(conv_p, x, dtype=cd))
                 x = max_pool(x)
         else:
-            x = jax.nn.relu(conv2d(params["convs"][0], x))
-            x = jax.nn.relu(conv2d(params["convs"][1], x))
+            x = jax.nn.relu(conv2d(params["convs"][0], x, dtype=cd))
+            x = jax.nn.relu(conv2d(params["convs"][1], x, dtype=cd))
             x = max_pool(x)
-            x = jax.nn.relu(conv2d(params["convs"][2], x))
+            x = jax.nn.relu(conv2d(params["convs"][2], x, dtype=cd))
             x = max_pool(x)
         x = x.reshape((x.shape[0], -1))
         for fc in params["fcs"][:-1]:
-            x = jax.nn.relu(dense(fc, x))
-        return dense(params["fcs"][-1], x)
+            x = jax.nn.relu(dense(fc, x, cd))
+        return dense(params["fcs"][-1], x, cd)
 
     return Model(
         name=name or f"leaf.femnist.{variant}",
@@ -105,9 +108,11 @@ def make_celeba_cnn(
     channels: Sequence[int] = (32, 64, 128),
     fc_dim: int = 256,
     name: str = "leaf.celeba",
+    compute_dtype=None,
 ) -> Model:
     """LeNet-style CelebA CNN for 84x84 RGB
     (reference: murmura/examples/leaf/datasets.py:235-297)."""
+    cd = resolve_dtype(compute_dtype)
     n_conv = len(channels)
     final_hw = image_size // (2**n_conv)
     flat_dim = final_hw * final_hw * channels[-1]
@@ -125,11 +130,11 @@ def make_celeba_cnn(
 
     def apply(params, x, key=None, train=False):
         for conv_p in params["convs"]:
-            x = jax.nn.relu(conv2d(conv_p, x))
+            x = jax.nn.relu(conv2d(conv_p, x, dtype=cd))
             x = max_pool(x)
         x = x.reshape((x.shape[0], -1))
-        x = jax.nn.relu(dense(params["fcs"][0], x))
-        return dense(params["fcs"][1], x)
+        x = jax.nn.relu(dense(params["fcs"][0], x, cd))
+        return dense(params["fcs"][1], x, cd)
 
     return Model(
         name=name,

@@ -68,8 +68,28 @@ def dense_init(key: jax.Array, in_dim: int, out_dim: int) -> Params:
     }
 
 
-def dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
-    return x @ p["w"] + p["b"]
+def resolve_dtype(compute_dtype) -> Optional[jnp.dtype]:
+    """Config string -> matmul compute dtype (None = full precision).
+
+    bfloat16 is the MXU-native input precision; params stay float32 and all
+    accumulations are forced to float32 via preferred_element_type, so only
+    the multiplicand precision drops (standard TPU mixed precision).
+    """
+    if compute_dtype in (None, "float32", jnp.float32):
+        return None
+    if compute_dtype in ("bfloat16", jnp.bfloat16):
+        return jnp.bfloat16
+    raise ValueError(f"Unknown compute_dtype: {compute_dtype!r}")
+
+
+def dense(p: Params, x: jnp.ndarray, dtype=None) -> jnp.ndarray:
+    if dtype is None:
+        return x @ p["w"] + p["b"]
+    y = jnp.dot(
+        x.astype(dtype), p["w"].astype(dtype),
+        preferred_element_type=jnp.float32,
+    )
+    return y + p["b"]
 
 
 def conv_init(key: jax.Array, kh: int, kw: int, c_in: int, c_out: int) -> Params:
@@ -85,15 +105,29 @@ def conv_init(key: jax.Array, kh: int, kw: int, c_in: int, c_out: int) -> Params
     }
 
 
-def conv2d(p: Params, x: jnp.ndarray, padding: str = "SAME") -> jnp.ndarray:
-    """NHWC conv with HWIO kernel."""
+def conv2d(
+    p: Params, x: jnp.ndarray, padding: str = "SAME", dtype=None
+) -> jnp.ndarray:
+    """NHWC conv with HWIO kernel.
+
+    Mixed precision note: unlike dot, conv's VJP rejects mixed-dtype
+    operands under preferred_element_type, so the low-precision path keeps
+    the conv uniformly in ``dtype`` (MXU accumulates f32 internally) and
+    casts the result back to float32.
+    """
+    w = p["w"]
+    if dtype is not None:
+        x = x.astype(dtype)
+        w = w.astype(dtype)
     y = jax.lax.conv_general_dilated(
         x,
-        p["w"],
+        w,
         window_strides=(1, 1),
         padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
+    if dtype is not None:
+        y = y.astype(jnp.float32)
     return y + p["b"]
 
 
@@ -128,10 +162,10 @@ def dropout(
     return jnp.where(mask, x / keep, 0.0)
 
 
-def evidential_head(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+def evidential_head(p: Params, x: jnp.ndarray, dtype=None) -> jnp.ndarray:
     """Dense -> softplus evidence -> alpha = evidence + 1
     (reference: murmura/examples/wearables/models.py:18-46)."""
-    return jax.nn.softplus(dense(p, x)) + 1.0
+    return jax.nn.softplus(dense(p, x, dtype)) + 1.0
 
 
 def split_keys(key: jax.Array, n: int) -> Sequence[jax.Array]:

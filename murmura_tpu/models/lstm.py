@@ -12,7 +12,7 @@ from typing import Tuple
 import jax
 import jax.numpy as jnp
 
-from murmura_tpu.models.core import Model, dense, dense_init
+from murmura_tpu.models.core import Model, dense, dense_init, resolve_dtype
 
 
 def _lstm_cell_init(key: jax.Array, in_dim: int, hidden: int):
@@ -25,9 +25,18 @@ def _lstm_cell_init(key: jax.Array, in_dim: int, hidden: int):
     }
 
 
-def _lstm_cell(p, x, h, c):
+def _lstm_cell(p, x, h, c, dtype=None):
     """One LSTM step; gates packed [i, f, g, o] in a single matmul."""
-    z = x @ p["wi"] + h @ p["wh"] + p["b"]
+    if dtype is not None:
+        z = (
+            jnp.dot(x.astype(dtype), p["wi"].astype(dtype),
+                    preferred_element_type=jnp.float32)
+            + jnp.dot(h.astype(dtype), p["wh"].astype(dtype),
+                      preferred_element_type=jnp.float32)
+            + p["b"]
+        )
+    else:
+        z = x @ p["wi"] + h @ p["wh"] + p["b"]
     i, f, g, o = jnp.split(z, 4, axis=-1)
     c_new = jax.nn.sigmoid(f + 1.0) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
     h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
@@ -41,8 +50,10 @@ def make_char_lstm(
     num_layers: int = 2,
     seq_len: int = 80,
     name: str = "leaf.shakespeare",
+    compute_dtype=None,
 ) -> Model:
     """Stacked char-LSTM predicting the next character from seq_len tokens."""
+    cd = resolve_dtype(compute_dtype)
 
     def init(key: jax.Array):
         keys = jax.random.split(key, num_layers + 2)
@@ -67,7 +78,7 @@ def make_char_lstm(
             inp = x_t
             new_hs, new_cs = [], []
             for l, cell in enumerate(params["cells"]):
-                h, c = _lstm_cell(cell, inp, hs[l], cs[l])
+                h, c = _lstm_cell(cell, inp, hs[l], cs[l], cd)
                 new_hs.append(h)
                 new_cs.append(c)
                 inp = h
@@ -76,7 +87,7 @@ def make_char_lstm(
         h0 = tuple(jnp.zeros((batch, hidden)) for _ in range(num_layers))
         c0 = tuple(jnp.zeros((batch, hidden)) for _ in range(num_layers))
         (hs, _), _ = jax.lax.scan(step, (h0, c0), jnp.swapaxes(emb, 0, 1))
-        return dense(params["out"], hs[-1])
+        return dense(params["out"], hs[-1], cd)
 
     return Model(
         name=name,

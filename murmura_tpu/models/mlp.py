@@ -19,6 +19,7 @@ from murmura_tpu.models.core import (
     evidential_head,
     layernorm,
     layernorm_init,
+    resolve_dtype,
 )
 
 
@@ -29,6 +30,7 @@ def make_mlp(
     dropout_rate: float = 0.0,
     evidential: bool = False,
     name: str = "mlp",
+    compute_dtype=None,
 ) -> Model:
     """Build an MLP ``Model``.
 
@@ -38,8 +40,10 @@ def make_mlp(
         num_classes: output classes.
         dropout_rate: dropout after each hidden block.
         evidential: if True, output Dirichlet alphas via softplus head.
+        compute_dtype: None/"float32" or "bfloat16" matmul inputs (MXU).
     """
     dims = [int(input_dim)] + [int(h) for h in hidden_dims]
+    cd = resolve_dtype(compute_dtype)
 
     def init(key: jax.Array):
         keys = jax.random.split(key, len(dims))
@@ -58,13 +62,13 @@ def make_mlp(
             jax.random.split(key, n_layers) if (train and key is not None) else [None] * n_layers
         )
         for i, layer in enumerate(params["layers"]):
-            x = dense(layer["fc"], x)
+            x = dense(layer["fc"], x, cd)
             x = layernorm(layer["ln"], x)
             x = jax.nn.relu(x)
             x = dropout(drop_keys[i], x, dropout_rate, train)
         if evidential:
-            return evidential_head(params["head"], x)
-        return dense(params["head"], x)
+            return evidential_head(params["head"], x, cd)
+        return dense(params["head"], x, cd)
 
     return Model(
         name=name,
@@ -83,6 +87,7 @@ def make_wearable_mlp(
     num_classes: int = 6,
     dropout: float = 0.3,
     name: str = "wearables.mlp",
+    compute_dtype=None,
 ) -> Model:
     """Evidential wearable classifier (reference: wearables/models.py:187-229
     — UCI HAR default: 561 -> 256 -> 128 -> Evidential(6))."""
@@ -93,4 +98,5 @@ def make_wearable_mlp(
         dropout_rate=dropout,
         evidential=True,
         name=name,
+        compute_dtype=compute_dtype,
     )

@@ -37,6 +37,7 @@ def build_model(factory: str, params: Dict[str, Any]) -> Model:
     """
     params = dict(params or {})
     f = factory.strip()
+    compute_dtype = params.pop("compute_dtype", None)
 
     if f == "mlp":
         evidential = bool(params.pop("evidential", False))
@@ -46,6 +47,7 @@ def build_model(factory: str, params: Dict[str, Any]) -> Model:
             num_classes=int(params.pop("num_classes", 10)),
             dropout_rate=float(params.pop("dropout", 0.0)),
             evidential=evidential,
+            compute_dtype=compute_dtype,
         )
 
     lowered = f.lower()
@@ -55,11 +57,15 @@ def build_model(factory: str, params: Dict[str, Any]) -> Model:
             tail = lowered.rsplit(".", 1)[-1]
             variant = tail if tail in FEMNIST_VARIANTS else "baseline"
         return make_femnist_cnn(
-            num_classes=int(params.pop("num_classes", 62)), variant=variant
+            num_classes=int(params.pop("num_classes", 62)), variant=variant,
+            compute_dtype=compute_dtype,
         )
 
     if "celeba" in lowered:
-        return make_celeba_cnn(num_classes=int(params.pop("num_classes", 2)))
+        return make_celeba_cnn(
+            num_classes=int(params.pop("num_classes", 2)),
+            compute_dtype=compute_dtype,
+        )
 
     if "shakespeare" in lowered:
         return make_char_lstm(
@@ -68,6 +74,7 @@ def build_model(factory: str, params: Dict[str, Any]) -> Model:
             hidden=int(params.pop("hidden", 256)),
             num_layers=int(params.pop("num_layers", 2)),
             seq_len=int(params.pop("seq_len", 80)),
+            compute_dtype=compute_dtype,
         )
 
     for prefix in ("examples.wearables.", "wearables."):
@@ -81,6 +88,7 @@ def build_model(factory: str, params: Dict[str, Any]) -> Model:
                 num_classes=int(defaults["num_classes"]),
                 dropout=float(defaults.get("dropout", 0.3)),
                 name=f"wearables.{kind}",
+                compute_dtype=compute_dtype,
             )
 
     raise ValueError(f"Unknown model factory: {factory!r}")

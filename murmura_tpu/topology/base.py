@@ -75,6 +75,25 @@ class Topology:
         """Adjacency as a numeric mask for the jitted aggregation step."""
         return self.adjacency.astype(dtype)
 
+    def circulant_offsets(self) -> "List[int] | None":
+        """Non-zero offsets o with adjacency[i, (i+o) % N] True for all i,
+        or None if the graph is not circulant.
+
+        Ring and k-regular graphs are generated as circulants; on such
+        graphs the neighbor exchange can be a sum of fixed circular shifts
+        (tpu.exchange: ppermute) instead of an adjacency matmul.
+        """
+        n = self.num_nodes
+        if n == 0:
+            return []
+        offsets = [int(o) for o in np.flatnonzero(self.adjacency[0])]
+        expected = np.zeros_like(self.adjacency)
+        cols = (np.arange(n)[:, None] + np.array(offsets, dtype=int)[None, :]) % n
+        expected[np.arange(n)[:, None], cols] = True
+        if np.array_equal(expected, self.adjacency):
+            return offsets
+        return None
+
     @classmethod
     def from_neighbors(cls, num_nodes: int, neighbors: List[List[int]]) -> "Topology":
         """Build from an adjacency list (reference-style constructor)."""

@@ -97,6 +97,10 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         max_samples=config.training.max_samples,
     )
     model_params = dict(config.model.params)
+    if config.backend == "tpu":
+        # MXU mixed precision: bfloat16 matmul/conv inputs, float32 params
+        # and accumulation (tpu.compute_dtype, default bfloat16).
+        model_params.setdefault("compute_dtype", config.tpu.compute_dtype)
     if (
         "wearables." in config.model.factory
         and "input_dim" not in model_params
@@ -122,6 +126,27 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
     # (evidential_trust.py:62-63); loss-probe rules use one training batch
     # (ubar.py:169).
     agg_params = dict(config.aggregation.params)
+
+    if config.backend == "tpu" and config.tpu.exchange == "ppermute":
+        # O(degree) neighbor exchange via circular shifts (see fedavg.py).
+        if config.aggregation.algorithm != "fedavg":
+            raise ValueError(
+                "tpu.exchange: ppermute currently supports algorithm: fedavg "
+                "only (distance/probe rules read the full gathered tensor); "
+                "use exchange: allgather"
+            )
+        if mobility is not None or config.dmtt is not None:
+            raise ValueError(
+                "tpu.exchange: ppermute requires a static circulant topology "
+                "(mobility/dmtt graphs change per round)"
+            )
+        offsets = topology.circulant_offsets()
+        if offsets is None:
+            raise ValueError(
+                f"tpu.exchange: ppermute requires a circulant topology "
+                f"(ring/k-regular); '{config.topology.type}' is not"
+            )
+        agg_params["exchange_offsets"] = offsets
     if config.aggregation.algorithm == "evidential_trust":
         probe_size = int(agg_params.get("max_eval_samples", 100))
     else:

@@ -25,6 +25,9 @@ def _cfg(backend: str) -> Config:
                       "params": {"input_dim": 24, "hidden_dims": [32],
                                  "num_classes": 4}},
             "backend": backend,
+            # Pin full precision so the two backends are numerically
+            # comparable; the tpu backend defaults to bfloat16 matmuls.
+            "tpu": {"compute_dtype": "float32"},
         }
     )
 
@@ -71,3 +74,46 @@ def test_wearable_window_params_sync_model_input_dim():
     )
     hist = build_network_from_config(cfg).train(rounds=1)
     assert len(hist["round"]) == 1  # forward pass shape-consistent
+
+
+def test_tpu_backend_bfloat16_learns():
+    cfg = _cfg("tpu")
+    cfg.tpu.compute_dtype = "bfloat16"
+    hist = build_network_from_config(cfg).train(rounds=3)
+    assert np.isfinite(hist["mean_loss"][-1])
+    assert hist["honest_accuracy"][-1] > 0.5
+
+
+def test_ppermute_exchange_matches_allgather():
+    # On a circulant graph, the roll-based O(degree) exchange must produce
+    # exactly the adjacency-matmul result.
+    def cfg(exchange):
+        c = _cfg("tpu")
+        c.topology.type = "k-regular"
+        c.topology.k = 4
+        c.aggregation.algorithm = "fedavg"
+        c.aggregation.params = {}
+        c.tpu.exchange = exchange
+        return c
+
+    hist_ag = build_network_from_config(cfg("allgather")).train(rounds=3)
+    hist_pp = build_network_from_config(cfg("ppermute")).train(rounds=3)
+    np.testing.assert_allclose(
+        hist_ag["mean_accuracy"], hist_pp["mean_accuracy"], atol=1e-5
+    )
+    np.testing.assert_allclose(
+        hist_ag["mean_loss"], hist_pp["mean_loss"], rtol=1e-4
+    )
+
+
+def test_ppermute_exchange_rejects_noncirculant():
+    import pytest as _pytest
+
+    c = _cfg("tpu")
+    c.topology.type = "erdos"
+    c.topology.p = 0.5
+    c.aggregation.algorithm = "fedavg"
+    c.aggregation.params = {}
+    c.tpu.exchange = "ppermute"
+    with _pytest.raises(ValueError, match="circulant"):
+        build_network_from_config(c)

@@ -132,7 +132,7 @@ def shakespeare_dir(tmp_path):
         "num_samples": [3, 2],
         "user_data": {
             "hamlet": {"x": [ctx] * 3, "y": ["a", "b", "~"]},  # ~ not in alphabet
-            "ophelia": {"x": [ctx] * 2, "y": ["c", " "]},
+            "ophelia": {"x": [ctx] * 2, "y": ["c", "—"]},  # em dash > U+FF
         },
     }
     (d / "all_data_0.json").write_text(json.dumps(blob))
@@ -147,10 +147,12 @@ def test_shakespeare_loader(shakespeare_dir):
     assert fa.num_classes == SHAKESPEARE_VOCAB
     valid = fa.mask.astype(bool)
     assert int(fa.num_samples.sum()) == 5
-    # '~' is outside the LEAF alphabet -> unknown index 80.
-    assert 80 in fa.y[valid].tolist()
-    a_idx = SHAKESPEARE_ALPHABET.index("a")
-    assert a_idx in fa.y[valid].tolist()
+    labels = fa.y[valid].tolist()
+    # '~' (latin-1, outside alphabet) and the em dash (> U+00FF) both land
+    # in the unknown bucket 80 — neither folds onto '?' (class 24).
+    assert labels.count(80) == 2
+    assert SHAKESPEARE_ALPHABET.index("?") not in labels
+    assert SHAKESPEARE_ALPHABET.index("a") in labels
 
 
 @pytest.fixture

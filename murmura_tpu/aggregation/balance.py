@@ -8,12 +8,16 @@ fallback-accept the closest neighbor when fewer than min_neighbors pass
 when nothing accepted (balance.py:140-175).
 """
 
+from typing import Optional, Sequence
+
 import jax.numpy as jnp
 
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     blend_with_own,
+    circulant_masked_mean,
+    circulant_neighbor_distances,
     masked_neighbor_mean,
     pairwise_l2_distances,
 )
@@ -55,23 +59,43 @@ def make_balance(
     kappa: float = 1.0,
     alpha: float = 0.5,
     min_neighbors: int = 1,
+    exchange_offsets: Optional[Sequence[int]] = None,
     **_params,
 ) -> AggregatorDef:
+    offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         lambda_t = round_idx / jnp.maximum(1, ctx.total_rounds)
         own_norm = jnp.sqrt(jnp.sum(own * own, axis=-1))
         threshold = gamma * jnp.exp(-kappa * lambda_t) * own_norm
 
-        dist = pairwise_l2_distances(own, bcast)
-        accepted = accept_with_closest_fallback(dist, adj, threshold, min_neighbors)
+        if offsets is not None:
+            # O(degree) circulant path (tpu.exchange: ppermute): distances,
+            # thresholding, closest-fallback, and the accepted mean all over
+            # k rolled copies instead of [N, N] tensors.
+            d_k = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
+            accept_k = d_k <= threshold[None, :]
+            count = accept_k.sum(axis=0)
+            closest = jnp.argmin(d_k, axis=0)  # offset index per node
+            fallback = (count < min_neighbors)[None, :] & (
+                jnp.arange(len(offsets))[:, None] == closest[None, :]
+            )
+            accept_k = (accept_k | fallback).astype(own.dtype)
+            neighbor_avg = circulant_masked_mean(bcast, accept_k, offsets)
+            accepted_count = accept_k.sum(axis=0)
+            degree = jnp.full((own.shape[0],), float(len(offsets)), own.dtype)
+        else:
+            dist = pairwise_l2_distances(own, bcast)
+            accepted = accept_with_closest_fallback(
+                dist, adj, threshold, min_neighbors
+            )
+            neighbor_avg = masked_neighbor_mean(bcast, accepted)
+            accepted_count = accepted.sum(axis=1)
+            degree = jnp.maximum(adj.sum(axis=1), 1.0)
 
-        neighbor_avg = masked_neighbor_mean(bcast, accepted)
-        has_accepted = accepted.sum(axis=1) > 0
-        new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
-
-        degree = jnp.maximum(adj.sum(axis=1), 1.0)
+        new_flat = blend_with_own(own, neighbor_avg, accepted_count > 0, alpha)
         stats = {
-            "acceptance_rate": accepted.sum(axis=1) / degree,
+            "acceptance_rate": accepted_count / degree,
             "threshold": threshold,
         }
         return new_flat, state, stats

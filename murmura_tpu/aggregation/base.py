@@ -113,6 +113,41 @@ def pairwise_l2_distances(
     return jnp.sqrt(jnp.maximum(d2, 0.0))
 
 
+def circulant_neighbor_distances(
+    own: jnp.ndarray, bcast: jnp.ndarray, offsets
+) -> jnp.ndarray:
+    """[k, N] distances D[o, i] = ||own_i - bcast[(i+o) % N]|| via circular
+    shifts — the O(degree) counterpart of the [N, N] pairwise matrix for
+    circulant graphs (tpu.exchange: ppermute). Each roll lowers to
+    boundary-slice collective-permutes on a sharded node axis, and the
+    direct elementwise norm avoids the Gram-identity cancellation the dense
+    path has to center against."""
+    return jnp.stack(
+        [
+            jnp.sqrt(
+                jnp.sum((own - jnp.roll(bcast, -o, axis=0)) ** 2, axis=-1)
+            )
+            for o in offsets
+        ]
+    )
+
+
+def circulant_masked_mean(
+    bcast: jnp.ndarray, accept_k: jnp.ndarray, offsets
+) -> jnp.ndarray:
+    """Weighted neighbor mean from per-offset acceptance.
+
+    Args:
+        bcast: [N, P] broadcast states.
+        accept_k: [k, N] accept weight for node i's neighbor at offset o.
+    """
+    acc = jnp.zeros_like(bcast)
+    for idx, o in enumerate(offsets):
+        acc = acc + accept_k[idx][:, None] * jnp.roll(bcast, -o, axis=0)
+    cnt = accept_k.sum(axis=0)
+    return acc / jnp.maximum(cnt, 1e-12)[:, None]
+
+
 def masked_neighbor_mean(bcast: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
     """Weighted neighbor mean per node: (W @ bcast) / row-sum, safe on empty rows."""
     totals = weights.sum(axis=1, keepdims=True)

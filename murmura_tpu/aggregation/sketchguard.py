@@ -9,6 +9,8 @@ rates drops below 0.3 (attack detection — sketchguard.py:189-204); that
 3-round window is this rule's carried state.
 """
 
+from typing import Optional, Sequence
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -18,6 +20,7 @@ from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     blend_with_own,
+    circulant_masked_mean,
     masked_neighbor_mean,
     pairwise_l2_distances,
 )
@@ -33,11 +36,13 @@ def make_sketchguard(
     min_neighbors: int = 1,
     network_seed: int = 42,
     attack_detection_window: int = 5,
+    exchange_offsets: Optional[Sequence[int]] = None,
     **_params,
 ) -> AggregatorDef:
     hash_np, sign_np = make_sketch_tables(model_dim, sketch_size, network_seed)
     hash_table = jnp.asarray(hash_np)
     sign_table = jnp.asarray(sign_np)
+    offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
 
     # The reference keeps a deque(maxlen=attack_detection_window) of
     # acceptance rates but its threshold logic only reads the last 3
@@ -72,7 +77,20 @@ def make_sketchguard(
 
         accepted = accept_with_closest_fallback(sk_dist, adj, threshold, min_neighbors)
 
-        neighbor_avg = masked_neighbor_mean(bcast, accepted)
+        if offsets is not None:
+            # The filter ran in cheap sketch space ([N, S]); only the
+            # full-state mean is heavy. On a circulant graph the accepted
+            # mask is nonzero only at the k offsets — extract those columns
+            # and accumulate rolled copies instead of an [N, N] @ [N, P]
+            # gather (tpu.exchange: ppermute).
+            n = own.shape[0]
+            cols = (
+                jnp.arange(n)[None, :] + jnp.asarray(offsets)[:, None]
+            ) % n  # [k, N]
+            accept_k = accepted[jnp.arange(n)[None, :], cols]  # [k, N]
+            neighbor_avg = circulant_masked_mean(bcast, accept_k, offsets)
+        else:
+            neighbor_avg = masked_neighbor_mean(bcast, accepted)
         has_accepted = accepted.sum(axis=1) > 0
         new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
 

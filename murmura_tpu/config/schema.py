@@ -161,6 +161,24 @@ class TPUConfig(_Strict):
         default=None,
         description="Devices in the mesh (None = all available devices)",
     )
+    multihost: bool = Field(
+        default=False,
+        description=(
+            "Initialize jax.distributed before building the mesh so the "
+            "node axis spans all hosts of a multi-host TPU slice (ICI "
+            "within a slice, DCN across slices). Coordinator settings come "
+            "from the standard JAX env vars unless given below."
+        ),
+    )
+    coordinator_address: Optional[str] = Field(
+        default=None, description="host:port of process 0 (multihost)"
+    )
+    num_processes: Optional[int] = Field(
+        default=None, description="Total JAX processes (multihost)"
+    )
+    process_id: Optional[int] = Field(
+        default=None, description="This process's id (multihost)"
+    )
     exchange: Literal["allgather", "ppermute"] = Field(
         default="allgather",
         description=(

@@ -220,6 +220,15 @@ class Network:
             for k, v in metrics.items()
             if k.startswith("agg_")
         }
+        # Per-round rule statistics (acceptance rates, thresholds, trust...)
+        # accumulate in the history under their agg_ keys — the reference
+        # buries these in aggregator-internal lists surfaced only via
+        # get_statistics() (e.g. balance.py:46-53).
+        for k, v in self._last_stats.items():
+            arr = np.asarray(v, dtype=np.float64)
+            self.history.setdefault(f"agg_{k}", []).append(
+                float(arr.mean()) if arr.ndim else float(arr)
+            )
 
         if verbose:
             line = f"Round {round_num}: Mean Accuracy = {acc.mean():.4f} ± {acc.std():.4f}"

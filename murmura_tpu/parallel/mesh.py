@@ -23,6 +23,34 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host JAX run (tpu.multihost: true).
+
+    After this, ``jax.devices()`` spans every host of the slice and the same
+    jitted round program runs SPMD with XLA routing intra-slice collectives
+    over ICI and cross-slice over DCN. Arguments default to the standard
+    JAX coordination env vars (JAX_COORDINATOR_ADDRESS etc. / TPU metadata).
+    Must run before anything initializes the XLA backend; a duplicate call
+    in the same process is ignored.
+    """
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:
+        msg = str(e).lower()
+        # jax phrases the duplicate-call error as "should only be called
+        # once" (older versions: "already initialized").
+        if "already" not in msg and "only be called once" not in msg:
+            raise
+
+
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:
     """1-D mesh over the first ``num_devices`` devices, axis name ``nodes``."""
     devices = jax.devices()
@@ -85,10 +113,14 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
         repl,  # round_idx
         data_s,  # data dict
     )
-    # Metrics are per-node [N] arrays -> node sharded.
+    # Outputs: params/agg_state stay node-sharded; the small per-node
+    # metrics arrays are replicated so the orchestrator's device_get works
+    # when the mesh spans multiple processes (multi-host: a node-sharded
+    # output would span non-addressable devices).
     donate_argnums = (0, 1) if donate else ()
     return jax.jit(
         step,
         in_shardings=in_shardings,
+        out_shardings=(params_s, agg_s, repl),
         donate_argnums=donate_argnums,
     )

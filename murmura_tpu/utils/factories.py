@@ -85,6 +85,18 @@ def build_mobility(config: Config) -> Optional[MobilityModel]:
 
 def build_network_from_config(config: Config, mesh=None) -> Network:
     """Full wiring: data + model + aggregator + attack -> Network."""
+    if config.backend == "tpu" and config.tpu.multihost and mesh is None:
+        # Must run before ANY jax call that initializes the XLA backend
+        # (the eval_shape below would); jax.distributed.initialize refuses
+        # to join a run after backend init.
+        from murmura_tpu.parallel.mesh import init_multihost
+
+        init_multihost(
+            coordinator_address=config.tpu.coordinator_address,
+            num_processes=config.tpu.num_processes,
+            process_id=config.tpu.process_id,
+        )
+
     n = config.topology.num_nodes
     seed = config.experiment.seed
     rounds = config.experiment.rounds
@@ -128,12 +140,13 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
     agg_params = dict(config.aggregation.params)
 
     if config.backend == "tpu" and config.tpu.exchange == "ppermute":
-        # O(degree) neighbor exchange via circular shifts (see fedavg.py).
-        if config.aggregation.algorithm != "fedavg":
+        # O(degree) neighbor exchange via circular shifts (see fedavg.py,
+        # balance.py, sketchguard.py circulant paths).
+        if config.aggregation.algorithm not in ("fedavg", "balance", "sketchguard"):
             raise ValueError(
-                "tpu.exchange: ppermute currently supports algorithm: fedavg "
-                "only (distance/probe rules read the full gathered tensor); "
-                "use exchange: allgather"
+                "tpu.exchange: ppermute supports fedavg/balance/sketchguard "
+                "(krum needs the global distance matrix; probe rules read "
+                "the full gathered tensor); use exchange: allgather"
             )
         if mobility is not None or config.dmtt is not None:
             raise ValueError(

@@ -32,11 +32,17 @@ non-finite receive zero trust.  Disable with ``strength_guard: false`` for
 strict reference parity.
 """
 
+from typing import Optional, Sequence
+
 import jax.numpy as jnp
 import numpy as np
 
 from murmura_tpu.aggregation.base import AggContext, AggregatorDef
-from murmura_tpu.aggregation.probe import evidential_trust_metric, pairwise_probe_eval
+from murmura_tpu.aggregation.probe import (
+    circulant_probe_eval,
+    evidential_trust_metric,
+    pairwise_probe_eval,
+)
 
 
 def make_evidential_trust(
@@ -54,15 +60,108 @@ def make_evidential_trust(
     track_statistics: bool = True,
     strength_guard: bool = True,
     strength_guard_factor: float = 10.0,
+    exchange_offsets: Optional[Sequence[int]] = None,
     **_params,
 ) -> AggregatorDef:
+    offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+
     def init_state(num_nodes: int):
         return {
             "smoothed_trust": np.zeros((num_nodes, num_nodes), dtype=np.float32),
             "trust_seen": np.zeros((num_nodes, num_nodes), dtype=np.float32),
         }
 
+    def _trust_from_metrics(vacuity, accuracy):
+        base_trust = (1.0 - vacuity) * (
+            accuracy_weight * accuracy + (1.0 - accuracy_weight)
+        )
+        penalty = jnp.where(
+            vacuity > vacuity_threshold,
+            jnp.exp(-(vacuity - vacuity_threshold)),
+            1.0,
+        )
+        return jnp.clip(base_trust * penalty, 0.0, 1.0)
+
+    def _current_threshold(round_idx, total_rounds):
+        if not use_tightening_threshold:
+            return jnp.asarray(trust_threshold)
+        lambda_t = round_idx / jnp.maximum(1, total_rounds)
+        decay = jnp.exp(-kappa * lambda_t)
+        return jnp.clip(
+            trust_threshold * (1.0 - gamma * decay), 0.05, trust_threshold
+        )
+
+    def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
+        """O(degree) path (tpu.exchange: ppermute): k x N probe forwards and
+        per-offset trust columns of the [N, N] smoothed-trust state, which
+        keeps its dense layout for checkpoint/statistics parity."""
+        n = own.shape[0]
+        k = len(offsets)
+        cols = (
+            jnp.arange(n)[None, :] + jnp.asarray(offsets)[:, None]
+        ) % n  # [k, N]
+        rows = jnp.arange(n)[None, :]
+
+        metrics = circulant_probe_eval(
+            bcast, offsets, ctx, evidential_trust_metric
+        )  # [k, N] each
+        vacuity = metrics["vacuity"]
+        trust_new = _trust_from_metrics(vacuity, metrics["accuracy"])
+
+        if strength_guard:
+            strength = metrics["strength"]  # [k, N]
+            order = jnp.sort(strength, axis=0)
+            median = order[(k - 1) // 2][None, :]
+            inflated = strength > strength_guard_factor * jnp.maximum(median, 1e-6)
+            finite = (
+                jnp.isfinite(trust_new)
+                & jnp.isfinite(vacuity)
+                & jnp.isfinite(strength)
+            )
+            trust_new = jnp.where(inflated | ~finite, 0.0, trust_new)
+
+        if use_adaptive_trust:
+            seen = state["trust_seen"][rows, cols]  # [k, N]
+            smoothed = (
+                trust_momentum * trust_new
+                + (1.0 - trust_momentum) * state["smoothed_trust"][rows, cols]
+            )
+            trust = jnp.where(seen > 0, smoothed, trust_new)
+            new_state = {
+                "smoothed_trust": state["smoothed_trust"].at[rows, cols].set(trust),
+                "trust_seen": state["trust_seen"].at[rows, cols].set(1.0),
+            }
+        else:
+            trust = trust_new
+            new_state = state
+
+        current_threshold = _current_threshold(round_idx, ctx.total_rounds)
+        accepted = trust >= current_threshold  # [k, N]
+        weights = jnp.where(accepted, trust, 0.0)
+        total = weights.sum(axis=0)
+        has_accepted = total > 0
+        norm_w = weights / jnp.maximum(total, 1e-12)[None, :]
+
+        neighbor_agg = jnp.zeros_like(bcast)
+        for idx, o in enumerate(offsets):
+            neighbor_agg = neighbor_agg + norm_w[idx][:, None] * jnp.roll(
+                bcast, -o, axis=0
+            )
+        blended = self_weight * own + (1.0 - self_weight) * neighbor_agg
+        new_flat = jnp.where(has_accepted[:, None], blended, own)
+
+        stats = {
+            "acceptance_rate": accepted.sum(axis=0) / float(k),
+            "mean_trust": trust.mean(axis=0),
+            "mean_vacuity": vacuity.mean(axis=0),
+            "mean_entropy": metrics["entropy"].mean(axis=0),
+            "threshold": jnp.broadcast_to(current_threshold, (n,)),
+        }
+        return new_flat, new_state, stats
+
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        if offsets is not None:
+            return aggregate_circulant(own, bcast, adj, round_idx, state, ctx)
         adj_b = adj.astype(bool)
 
         # Phase 1: cross-evaluate all broadcast models on all nodes' probe
@@ -75,15 +174,7 @@ def make_evidential_trust(
         vacuity = metrics["vacuity"]  # [N_i, N_j]
         accuracy = metrics["accuracy"]
 
-        base_trust = (1.0 - vacuity) * (
-            accuracy_weight * accuracy + (1.0 - accuracy_weight)
-        )
-        penalty = jnp.where(
-            vacuity > vacuity_threshold,
-            jnp.exp(-(vacuity - vacuity_threshold)),
-            1.0,
-        )
-        trust_new = jnp.clip(base_trust * penalty, 0.0, 1.0)
+        trust_new = _trust_from_metrics(vacuity, accuracy)
 
         if strength_guard:
             # Evidence-inflation guard (see module docstring): a neighbor
@@ -121,15 +212,7 @@ def make_evidential_trust(
             new_state = state
 
         # Phase 2: tightening threshold + filtering.
-        if use_tightening_threshold:
-            lambda_t = round_idx / jnp.maximum(1, ctx.total_rounds)
-            decay = jnp.exp(-kappa * lambda_t)
-            current_threshold = jnp.clip(
-                trust_threshold * (1.0 - gamma * decay), 0.05, trust_threshold
-            )
-        else:
-            current_threshold = jnp.asarray(trust_threshold)
-
+        current_threshold = _current_threshold(round_idx, ctx.total_rounds)
         accepted = adj_b & (trust >= current_threshold)
         weights = jnp.where(accepted, trust, 0.0)
         total = weights.sum(axis=1)

@@ -47,6 +47,39 @@ def pairwise_probe_eval(
     return {k: v.T for k, v in per_j.items()}
 
 
+def circulant_probe_eval(
+    bcast: jnp.ndarray,
+    offsets,
+    ctx: AggContext,
+    metric_fn: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], Dict[str, jnp.ndarray]],
+) -> Dict[str, jnp.ndarray]:
+    """Evaluate each node's k circulant neighbors on its own probe batch.
+
+    The O(degree) counterpart of :func:`pairwise_probe_eval` for
+    tpu.exchange: ppermute — k x N probe forwards instead of N x N, with the
+    neighbor states materialized per offset by a circular shift.
+
+    Returns:
+        dict of [k, N] arrays, entry [o, i] = metric of the model of node
+        (i + offsets[o]) % N evaluated on node i's probe data.
+    """
+
+    def eval_one(flat_j, x_i, y_i, m_i):
+        params = ctx.unravel(flat_j)
+        outputs = ctx.apply_fn(params, x_i, None, False)
+        return metric_fn(outputs, y_i, m_i)
+
+    per_offset = [
+        jax.vmap(eval_one)(
+            jnp.roll(bcast, -o, axis=0), ctx.probe_x, ctx.probe_y, ctx.probe_mask
+        )
+        for o in offsets
+    ]
+    return {
+        key: jnp.stack([m[key] for m in per_offset]) for key in per_offset[0]
+    }
+
+
 def ce_loss_metric(outputs, y, mask):
     """Masked mean CE loss (UBAR stage-2 probe — ubar.py:204-222)."""
     logp = jax.nn.log_softmax(outputs, axis=-1)

@@ -12,27 +12,85 @@ cross-evaluation of the gathered [N, P] tensor (see aggregation/probe.py);
 the own-loss baseline is the vmapped diagonal over the true own states.
 """
 
+from typing import Optional, Sequence
+
 import jax.numpy as jnp
 
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     blend_with_own,
+    circulant_masked_mean,
+    circulant_neighbor_distances,
     masked_neighbor_mean,
     pairwise_l2_distances,
     rank_mask,
     self_probe_metrics,
 )
-from murmura_tpu.aggregation.probe import ce_loss_metric, pairwise_probe_eval
+from murmura_tpu.aggregation.probe import (
+    ce_loss_metric,
+    circulant_probe_eval,
+    pairwise_probe_eval,
+)
 
 
 def make_ubar(
     rho: float = 0.4,
     alpha: float = 0.5,
     min_neighbors: int = 1,
+    exchange_offsets: Optional[Sequence[int]] = None,
     **_params,
 ) -> AggregatorDef:
+    offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+
+    def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
+        """O(degree) path (tpu.exchange: ppermute): distances, the stage-2
+        loss probe (k x N forwards instead of N x N), and the accepted mean
+        all run over k rolled copies."""
+        n = own.shape[0]
+        k = len(offsets)
+
+        # Stage 1: rho * k closest of the k circulant neighbors (degree is
+        # the compile-time constant k here).
+        d_nk = circulant_neighbor_distances(own, bcast, offsets).T  # [N, k]
+        num_select = max(min_neighbors, int(rho * k))
+        shortlist = rank_mask(
+            d_nk, jnp.ones_like(d_nk, dtype=bool),
+            jnp.full((n,), num_select, jnp.int32),
+        )  # [N, k]
+
+        # Stage 2: loss probe per offset.
+        losses = circulant_probe_eval(bcast, offsets, ctx, ce_loss_metric)[
+            "loss"
+        ].T  # [N, k]
+        own_loss = self_probe_metrics(own, ctx, ce_loss_metric)["loss"]
+        passed = shortlist & (losses <= own_loss[:, None])
+
+        shortlist_losses = jnp.where(shortlist, losses, jnp.inf)
+        best = jnp.argmin(shortlist_losses, axis=1)  # [N] offset index
+        fallback = (
+            jnp.arange(k)[None, :] == best[:, None]
+        ) & shortlist
+        none_passed = ~passed.any(axis=1)
+        accepted = jnp.where(
+            (none_passed & shortlist.any(axis=1))[:, None], fallback, passed
+        ).astype(own.dtype)  # [N, k]
+
+        neighbor_avg = circulant_masked_mean(bcast, accepted.T, offsets)
+        has_accepted = accepted.sum(axis=1) > 0
+        new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
+
+        shortlist_count = jnp.maximum(shortlist.sum(axis=1).astype(own.dtype), 1.0)
+        stats = {
+            "stage1_acceptance_rate": shortlist.sum(axis=1) / float(k),
+            "stage2_acceptance_rate": accepted.sum(axis=1) / shortlist_count,
+            "own_loss": own_loss,
+        }
+        return new_flat, state, stats
+
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        if offsets is not None:
+            return aggregate_circulant(own, bcast, adj, round_idx, state, ctx)
         n = own.shape[0]
         adj_b = adj.astype(bool)
         degree = adj.sum(axis=1)

@@ -37,18 +37,13 @@ def init_multihost(
     Must run before anything initializes the XLA backend; a duplicate call
     in the same process is ignored.
     """
-    try:
-        jax.distributed.initialize(
-            coordinator_address=coordinator_address,
-            num_processes=num_processes,
-            process_id=process_id,
-        )
-    except RuntimeError as e:
-        msg = str(e).lower()
-        # jax phrases the duplicate-call error as "should only be called
-        # once" (older versions: "already initialized").
-        if "already" not in msg and "only be called once" not in msg:
-            raise
+    if getattr(jax.distributed, "is_initialized", lambda: False)():
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
 
 
 def make_mesh(num_devices: Optional[int] = None) -> Mesh:

@@ -140,13 +140,13 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
     agg_params = dict(config.aggregation.params)
 
     if config.backend == "tpu" and config.tpu.exchange == "ppermute":
-        # O(degree) neighbor exchange via circular shifts (see fedavg.py,
-        # balance.py, sketchguard.py circulant paths).
-        if config.aggregation.algorithm not in ("fedavg", "balance", "sketchguard"):
+        # O(degree) neighbor exchange via circular shifts (circulant paths
+        # in fedavg/balance/sketchguard/ubar/evidential_trust).
+        if config.aggregation.algorithm == "krum":
             raise ValueError(
-                "tpu.exchange: ppermute supports fedavg/balance/sketchguard "
-                "(krum needs the global distance matrix; probe rules read "
-                "the full gathered tensor); use exchange: allgather"
+                "tpu.exchange: ppermute does not support krum (its selection "
+                "needs the global pairwise-distance matrix); use "
+                "exchange: allgather"
             )
         if mobility is not None or config.dmtt is not None:
             raise ValueError(

@@ -119,30 +119,29 @@ def test_ppermute_exchange_rejects_noncirculant():
         build_network_from_config(c)
 
 
-def test_ppermute_balance_sketchguard_match_allgather():
-    def cfg(exchange, algo, params):
+import pytest
+
+
+@pytest.mark.parametrize("algo,params", [
+    ("balance", {"gamma": 1.5}),
+    ("sketchguard", {"sketch_size": 64}),
+    ("ubar", {"rho": 0.6}),
+    ("evidential_trust", {"trust_threshold": 0.1}),
+])
+def test_ppermute_circulant_rule_matches_allgather(algo, params):
+    def cfg(exchange):
         c = _cfg("tpu")
         c.topology.type = "ring"
         c.aggregation.algorithm = algo
-        c.aggregation.params = params
+        c.aggregation.params = dict(params)
         c.tpu.exchange = exchange
         return c
 
-    for algo, params in (
-        ("balance", {"gamma": 1.5}),
-        ("sketchguard", {"sketch_size": 64}),
-    ):
-        hist_ag = build_network_from_config(
-            cfg("allgather", algo, dict(params))
-        ).train(rounds=3)
-        hist_pp = build_network_from_config(
-            cfg("ppermute", algo, dict(params))
-        ).train(rounds=3)
-        np.testing.assert_allclose(
-            hist_ag["mean_loss"], hist_pp["mean_loss"], rtol=1e-3,
-            err_msg=algo,
-        )
-        np.testing.assert_allclose(
-            hist_ag["mean_accuracy"], hist_pp["mean_accuracy"], atol=1e-3,
-            err_msg=algo,
-        )
+    hist_ag = build_network_from_config(cfg("allgather")).train(rounds=3)
+    hist_pp = build_network_from_config(cfg("ppermute")).train(rounds=3)
+    np.testing.assert_allclose(
+        hist_ag["mean_loss"], hist_pp["mean_loss"], rtol=1e-3
+    )
+    np.testing.assert_allclose(
+        hist_ag["mean_accuracy"], hist_pp["mean_accuracy"], atol=1e-3
+    )

@@ -53,7 +53,7 @@ def run_one(cfg_path: Path, out_json: Path, timeout: float) -> dict:
     record.update(
         final_accuracy=acc[-1] if acc else None,
         peak_accuracy=max(acc) if acc else None,
-        final_std=hist.get("std_accuracy", [None])[-1],
+        final_std=(hist.get("std_accuracy") or [None])[-1],
         honest_accuracy=(hist.get("honest_accuracy") or [None])[-1],
         rounds=len(acc),
     )

@@ -40,7 +40,14 @@ def build_aggregator(
     if algo == "krum" and "f" in params:
         # Reference configs name the Byzantine tolerance "f"
         # (examples/configs/uci_har_byzantine.yaml).
-        params.setdefault("num_compromised", params.pop("f"))
+        f = params.pop("f")
+        if "num_compromised" in params and params["num_compromised"] != f:
+            raise ValueError(
+                f"krum config supplies both f={f} and "
+                f"num_compromised={params['num_compromised']} with different "
+                "values; they are aliases — set exactly one"
+            )
+        params.setdefault("num_compromised", f)
     if algo == "sketchguard":
         params.setdefault("model_dim", model_dim)
     return AGGREGATORS[algo](**params)

@@ -113,7 +113,12 @@ def _celeba_from_json(
     image_size x image_size RGB in [0, 1], NHWC for TPU convs
     (reference semantics: examples/leaf/datasets.py:96-199, which emits CHW
     for torch)."""
-    from PIL import Image
+    try:
+        from PIL import Image
+    except ImportError as e:
+        raise ImportError(
+            "CelebA image decoding needs Pillow: pip install 'murmura-tpu[data]'"
+        ) from e
 
     image_size = int(params.get("image_size", 84))
     users, user_data = _load_leaf_json_dir(data_path / "train")

@@ -23,7 +23,7 @@ import jax
 import numpy as np
 from flax import serialization
 
-CKPT_VERSION = 1
+CKPT_VERSION = 2
 STATE_FILE = "state.msgpack"
 META_FILE = "meta.json"
 
@@ -46,6 +46,10 @@ def save_checkpoint(
             "params": jax.device_get(params),
             "agg_state": jax.device_get(agg_state),
             "rng": jax.device_get(rng),
+            # Duplicated in meta.json; restore cross-checks the two so a
+            # crash landing between the two os.replace calls (new state,
+            # old meta) is detected instead of silently replaying rounds.
+            "round": np.int64(round_num),
         }
     )
     meta = json.dumps(
@@ -56,9 +60,9 @@ def save_checkpoint(
             "round_times": [float(t) for t in round_times],
         }
     )
-    # Atomic: a kill mid-write must not leave a readable-but-corrupt pair.
-    # State lands before meta so a crash between the two leaves the old
-    # meta pointing at old state, never new meta over truncated state.
+    # Each file is replaced atomically, but the pair is not: a crash between
+    # the two os.replace calls leaves NEW state beside OLD meta.  The round
+    # number embedded in the blob lets restore detect that torn pair.
     tmp_state = d / (STATE_FILE + ".tmp")
     tmp_state.write_bytes(blob)
     os.replace(tmp_state, d / STATE_FILE)
@@ -91,9 +95,17 @@ def restore_checkpoint(
             "params": jax.device_get(params_target),
             "agg_state": jax.device_get(agg_state_target),
             "rng": jax.device_get(rng_target),
+            "round": np.int64(0),
         },
         (d / STATE_FILE).read_bytes(),
     )
+    if int(state["round"]) != int(meta["round"]):
+        raise ValueError(
+            f"Torn checkpoint: state.msgpack is at round {int(state['round'])} "
+            f"but meta.json says round {int(meta['round'])} — the writer "
+            "crashed between the two atomic replaces; restart from a clean "
+            "checkpoint directory"
+        )
     return (
         state["params"],
         state["agg_state"],

@@ -62,6 +62,37 @@ def test_checkpoint_resume_bit_identical(tmp_path):
     )
 
 
+def test_torn_checkpoint_detected(tmp_path):
+    """A crash between the state and meta replaces leaves new state beside
+    old meta; restore must refuse rather than silently replay rounds."""
+    import json
+
+    import pytest
+
+    ckpt = tmp_path / "ckpt"
+    net = _make_network()
+    net.train(rounds=2, checkpoint_dir=str(ckpt))
+
+    meta_path = ckpt / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["round"] = meta["round"] - 1  # simulate stale meta beside new state
+    meta_path.write_text(json.dumps(meta))
+
+    fresh = _make_network()
+    with pytest.raises(ValueError, match="[Tt]orn"):
+        fresh.restore_checkpoint(str(ckpt))
+
+
+def test_krum_f_num_compromised_conflict():
+    import pytest
+
+    # Alias and canonical name agreeing is fine…
+    build_aggregator("krum", {"f": 1, "num_compromised": 1})
+    # …but conflicting values must be rejected, not silently resolved.
+    with pytest.raises(ValueError, match="num_compromised"):
+        build_aggregator("krum", {"f": 1, "num_compromised": 2})
+
+
 def test_round_counter_persists_across_train_calls():
     net = _make_network()
     net.train(rounds=2)

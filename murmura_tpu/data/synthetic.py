@@ -17,14 +17,39 @@ def make_synthetic(
     num_classes: int = 10,
     cluster_std: float = 1.0,
     seed: int = 0,
+    separation: Optional[float] = None,
+    label_noise: float = 0.0,
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """Gaussian class clusters: x ~ N(mu_c, std), y = c."""
+    """Gaussian class clusters: x ~ N(mu_c, std), y = c.
+
+    ``separation`` controls difficulty: when set, centers are scaled so the
+    *expected pairwise center distance* is ``separation * cluster_std``
+    (along the discriminant between two classes the projected noise std is
+    ``cluster_std``, so Bayes pairwise error ~ Phi(-separation/2) regardless
+    of dimensionality).  When ``None``, the legacy smoke-test behavior is
+    kept — centers ~ N(0, 2) per dim, which in high dimension is trivially
+    separable (round-1 weakness: every paper-matrix experiment saturated at
+    accuracy 1.0000 and could not distinguish the aggregation rules).
+
+    ``label_noise`` flips that fraction of labels to a uniformly random
+    *other* class, setting an irreducible error floor the way real sensor
+    datasets have one.
+    """
     rng = np.random.default_rng(seed)
     input_shape = tuple(input_shape)
     dim = int(np.prod(input_shape))
-    centers = rng.normal(0.0, 2.0, size=(num_classes, dim))
+    centers = rng.normal(0.0, 1.0, size=(num_classes, dim))
+    if separation is None:
+        centers *= 2.0
+    else:
+        # E||c_i - c_j|| for N(0, s^2) coords is s*sqrt(2*dim); solve for s.
+        centers *= float(separation) * cluster_std / np.sqrt(2.0 * dim)
     y = rng.integers(0, num_classes, size=num_samples)
     x = centers[y] + rng.normal(0.0, cluster_std, size=(num_samples, dim))
+    if label_noise > 0.0:
+        flip = rng.random(num_samples) < label_noise
+        shift = rng.integers(1, num_classes, size=num_samples)
+        y = np.where(flip, (y + shift) % num_classes, y)
     return x.reshape((num_samples,) + input_shape).astype(np.float32), y.astype(
         np.int32
     )

@@ -191,4 +191,11 @@ def self_probe_metrics(
         outputs = ctx.apply_fn(params, x_i, None, False)
         return metric_fn(outputs, y_i, m_i)
 
-    return jax.vmap(one)(own, ctx.probe_x, ctx.probe_y, ctx.probe_mask)
+    n = own.shape[0]
+    # A leading probe dim of 1 means "one shared evaluator batch" (the ZMQ
+    # LocalNode mini-network) — broadcast it across the node axis.
+    px, py, pm = (
+        jnp.broadcast_to(a, (n,) + a.shape[1:]) if a.shape[0] == 1 and n != 1 else a
+        for a in (ctx.probe_x, ctx.probe_y, ctx.probe_mask)
+    )
+    return jax.vmap(one)(own, px, py, pm)

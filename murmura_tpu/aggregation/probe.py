@@ -32,19 +32,26 @@ def pairwise_probe_eval(
 
     Returns:
         dict of [N, N] arrays, entry [i, j] = metric of model j on node i's data.
+
+    ``probe_x`` may carry a leading dim of 1 — a single evaluator whose
+    metrics broadcast to every row.  The ZMQ LocalNode uses this: its
+    mini-network consumes only row 0, so evaluating one probe batch per
+    model (O(M) forwards) replaces the M x M cross-eval of the tiled
+    layout while producing identical rows.
     """
-    n, b = ctx.probe_x.shape[:2]
-    xs = ctx.probe_x.reshape((n * b,) + ctx.probe_x.shape[2:])
+    n = flat.shape[0]
+    n_eval, b = ctx.probe_x.shape[:2]
+    xs = ctx.probe_x.reshape((n_eval * b,) + ctx.probe_x.shape[2:])
 
     def eval_one_model(flat_j: jnp.ndarray) -> Dict[str, jnp.ndarray]:
         params = ctx.unravel(flat_j)
-        outputs = ctx.apply_fn(params, xs, None, False)  # [N*B, K]
-        outputs = outputs.reshape(n, b, -1)
+        outputs = ctx.apply_fn(params, xs, None, False)  # [n_eval*B, K]
+        outputs = outputs.reshape(n_eval, b, -1)
         return jax.vmap(metric_fn)(outputs, ctx.probe_y, ctx.probe_mask)
 
-    # scan over models j -> dict of [N_j, N_i]; transpose to [N_i, N_j].
+    # scan over models j -> dict of [N_j, n_eval]; transpose to [n_eval, N_j].
     per_j = jax.lax.map(eval_one_model, flat)
-    return {k: v.T for k, v in per_j.items()}
+    return {k: jnp.broadcast_to(v.T, (n, n)) for k, v in per_j.items()}
 
 
 def circulant_probe_eval(

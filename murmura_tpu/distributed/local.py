@@ -11,12 +11,13 @@ node_process.py:259-269).  A fixed M = 1 + max_degree keeps shapes static so
 nothing recompiles as the arrival set varies round to round.
 
 Known tradeoff: reusing the square network-wide rules means the mini network
-computes all M rows (and, for probe-based rules, M^2 cross-evaluations)
-although only row 0 is consumed — an O(degree) overhead per process accepted
-to keep one implementation of every rule.  The TPU backend has no such
-waste (every row of the global computation belongs to a real node); if ZMQ
-per-round CPU cost ever matters, specialize pairwise_probe_eval to a single
-evaluator row here.
+computes all M rows of the cheap O(P)-per-entry math (distances, trust
+updates) although only row 0 is consumed — an O(degree) overhead per process
+accepted to keep one implementation of every rule.  The expensive part does
+NOT pay that tax: probe-based rules (UBAR stage 2, evidential trust, DMTT
+scoring) receive this node's probe batch with a leading dim of 1, so each of
+the M models is forwarded once (reference per-node cost, ubar.py:152-202)
+rather than M^2 times.
 """
 
 from typing import Dict, List, Optional, Tuple
@@ -210,9 +211,14 @@ class LocalNode:
         ctx = AggContext(
             apply_fn=self.model.apply,
             unravel=self._unravel,
-            probe_x=jnp.tile(self._probe_x[None], (m,) + (1,) * self._probe_x.ndim),
-            probe_y=jnp.tile(self._probe_y[None], (m, 1)),
-            probe_mask=jnp.tile(self._probe_mask[None], (m, 1)),
+            # Leading dim 1 = single evaluator: probe-based rules evaluate
+            # each of the M models ONCE on this node's batch (O(M) forwards)
+            # and broadcast the metric row, instead of the M x M cross-eval
+            # a tiled [M, B, ...] layout would cost.  Only row 0 of the mini
+            # network is consumed, and all rows are identical either way.
+            probe_x=self._probe_x[None],
+            probe_y=self._probe_y[None],
+            probe_mask=self._probe_mask[None],
             evidential=self.evidential,
             num_classes=self.num_classes,
             total_rounds=self.total_rounds,

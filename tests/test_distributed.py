@@ -152,8 +152,8 @@ class TestFullStack:
                 "distributed": {
                     "transport": "ipc",
                     "ipc_dir": str(tmp_path),
-                    "round_duration_s": 25.0,
-                    "startup_grace_s": 30.0,
+                    "round_duration_s": 45.0,  # generous: suite may share cores with heavy jobs
+                    "startup_grace_s": 60.0,
                 },
             }
         )

@@ -33,8 +33,8 @@ def _dmtt_cfg(tmp_path, num_nodes=4, rounds=2, mobility=True, attack=False):
         "distributed": {
             "transport": "ipc",
             "ipc_dir": str(tmp_path),
-            "round_duration_s": 25.0,
-            "startup_grace_s": 30.0,
+            "round_duration_s": 45.0,  # generous: suite may share cores with heavy jobs
+            "startup_grace_s": 60.0,
         },
     }
     if mobility:

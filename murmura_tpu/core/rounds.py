@@ -93,6 +93,7 @@ def build_round_program(
     lambda_weight: float = 0.1,
     eval_chunk: int = 1024,
     dmtt: Optional[DMTTParams] = None,
+    param_dtype: Optional[str] = None,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -123,6 +124,15 @@ def build_round_program(
     # ---- initial stacked params ------------------------------------------
     init_keys = jax.random.split(jax.random.PRNGKey(seed), n)
     init_params = jax.vmap(model.init)(init_keys)
+    if param_dtype not in (None, "float32"):
+        # tpu.param_dtype=bfloat16: store the stacked [N, ...] state (and
+        # therefore the gathered/exchanged [N, P] tensor) in bf16 — halves
+        # resident HBM and ICI bytes at the cost of parameter precision.
+        # compute_dtype independently controls matmul input precision.
+        dt = jnp.dtype(param_dtype)
+        init_params = jax.tree_util.tree_map(
+            lambda l: l.astype(dt), init_params
+        )
     template = jax.tree_util.tree_map(lambda l: l[0], init_params)
     ravel, unravel, model_dim = make_flatteners(template)
 
@@ -185,8 +195,13 @@ def build_round_program(
                     params, xb, yb, batch_mask, node_keys, round_idx
                 )
                 update = honest * (t < d["steps"]).astype(jnp.float32)  # [N]
+                # Update math in float32, cast back: keeps bf16 params
+                # (tpu.param_dtype) dtype-stable through the scan carry and
+                # rounds once per step instead of per multiply.
                 new_params = jax.tree_util.tree_map(
-                    lambda p, g: p - lr * _broadcast_to_leaf(update, p) * g,
+                    lambda p, g: (
+                        p - lr * _broadcast_to_leaf(update, p) * g.astype(jnp.float32)
+                    ).astype(p.dtype),
                     params,
                     grads,
                 )
@@ -275,7 +290,11 @@ def build_round_program(
         # 2. snapshot + attack on outgoing states (network.py:105-119)
         own_flat = jax.vmap(ravel)(params)
         if attack_apply is not None:
-            bcast = attack_apply(own_flat, compromised, attack_key, round_idx)
+            # Cast back: float32 attack noise must not promote the exchanged
+            # [N, P] tensor when params are stored bfloat16 (tpu.param_dtype).
+            bcast = attack_apply(
+                own_flat, compromised, attack_key, round_idx
+            ).astype(own_flat.dtype)
         else:
             bcast = own_flat
 

@@ -140,3 +140,15 @@ class Monitor:
             self.history["mean_strength"].append(
                 float(np.mean([m["strength"] for m in rows]))
             )
+
+        # Per-round aggregator statistics, mean over reporting nodes — same
+        # agg_<key> schema the simulation/tpu history records
+        # (core/network.py), so the two backends' histories stay comparable.
+        agg_keys = sorted({k for m in rows for k in m.get("stats", {})})
+        for k in agg_keys:
+            vals = [
+                float(np.asarray(m["stats"][k], dtype=np.float64).mean())
+                for m in rows
+                if k in m.get("stats", {})
+            ]
+            self.history.setdefault(f"agg_{k}", []).append(float(np.mean(vals)))

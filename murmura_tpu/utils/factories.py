@@ -199,6 +199,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         annealing_rounds=max(1, rounds // 2),
         lambda_weight=0.1,
         dmtt=dmtt,
+        param_dtype=config.tpu.param_dtype if config.backend == "tpu" else None,
     )
 
     if config.backend == "tpu" and mesh is None:

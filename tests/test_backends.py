@@ -84,6 +84,23 @@ def test_tpu_backend_bfloat16_learns():
     assert hist["honest_accuracy"][-1] > 0.5
 
 
+def test_tpu_param_dtype_bfloat16():
+    # tpu.param_dtype=bfloat16 stores the stacked node state (and the
+    # exchanged [N, P] tensor) in bf16; it must actually take effect and
+    # stay stable across rounds (attack noise must not promote it back).
+    import jax.numpy as jnp
+    from jax.tree_util import tree_leaves
+
+    cfg = _cfg("tpu")
+    cfg.tpu.param_dtype = "bfloat16"
+    net = build_network_from_config(cfg)
+    assert all(l.dtype == jnp.bfloat16 for l in tree_leaves(net.params))
+    hist = net.train(rounds=2)
+    assert all(l.dtype == jnp.bfloat16 for l in tree_leaves(net.params))
+    assert np.isfinite(hist["mean_loss"][-1])
+    assert hist["honest_accuracy"][-1] > 0.4
+
+
 def test_ppermute_exchange_matches_allgather():
     # On a circulant graph, the roll-based O(degree) exchange must produce
     # exactly the adjacency-matmul result.

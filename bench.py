@@ -8,53 +8,88 @@ adjacency-masked exchange, Krum selection over the gathered [N, P] tensor,
 eval — is one jitted program on the default device (the real TPU chip under
 the driver).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
+extras (backend, probe log, compile time, per-round times, flops, MFU).
 The reference publishes no throughput numbers (BASELINE.md); vs_baseline is
 measured against the north-star target of 50 FL rounds/sec (BASELINE.json).
+
+The TPU behind the ``axon`` tunnel is single-tenant and intermittently
+unavailable; a wedged init hangs inside one PJRT C++ call that in-process
+watchdogs cannot interrupt, so the probe runs in subprocesses and retries
+before falling back to CPU.  Every attempt is logged in the output JSON so
+a CPU fallback is attributable to infrastructure, not the framework.
 """
 
 import json
+import subprocess
+import sys
 import time
 
+# Peak dense matmul throughput per chip, bf16, from public TPU specs
+# (cloud.google.com/tpu/docs/system-architecture-tpu-vm).  Used only for
+# the MFU estimate; unknown device kinds record mfu=null.
+PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
 
-def _ensure_backend(init_timeout_s: int = 180):
-    """Prefer the real TPU; fall back to CPU if the tunnel is unavailable or
-    hangs during init, so the driver always gets its JSON line (the backend
-    used is recorded in the metric name).
 
-    The probe runs in a subprocess: a broken-tunnel hang sits inside one
-    long PJRT C++ call that in-process watchdogs (SIGALRM) cannot interrupt.
-    """
-    import subprocess
-    import sys
-
+def _probe_once(timeout_s: float) -> dict:
+    """One subprocess probe of the default jax backend."""
+    t0 = time.perf_counter()
     try:
         probe = subprocess.run(
             [sys.executable, "-c",
-             "import jax; jax.devices(); print(jax.default_backend())"],
+             "import jax; d=jax.devices(); "
+             "print(jax.default_backend(), '|', d[0].device_kind)"],
             capture_output=True,
             text=True,
-            timeout=init_timeout_s,
+            timeout=timeout_s,
         )
+        elapsed = round(time.perf_counter() - t0, 1)
         if probe.returncode == 0 and probe.stdout.strip():
-            return probe.stdout.strip().splitlines()[-1]
+            backend, _, kind = probe.stdout.strip().splitlines()[-1].partition("|")
+            return {"ok": True, "s": elapsed, "backend": backend.strip(),
+                    "device_kind": kind.strip()}
+        return {"ok": False, "s": elapsed, "rc": probe.returncode,
+                "err": (probe.stderr or "")[-300:]}
     except subprocess.TimeoutExpired:
-        pass
-
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    return "cpu-fallback"
+        return {"ok": False, "s": round(time.perf_counter() - t0, 1),
+                "err": f"timeout after {timeout_s}s"}
 
 
-def main():
-    backend = _ensure_backend()
-    on_cpu = "cpu" in backend
+def probe_backend(attempts: int = 3, timeout_s: float = 60.0,
+                  pause_s: float = 45.0):
+    """Retry the TPU probe before giving up (VERDICT r1: a single failed
+    probe silently benchmarked CPU; retries + logging make the fallback
+    attributable)."""
+    log = []
+    for i in range(attempts):
+        r = _probe_once(timeout_s)
+        log.append(r)
+        if r.get("ok"):
+            return r["backend"], r.get("device_kind", ""), log
+        if i + 1 < attempts:
+            time.sleep(pause_s)
+    return "cpu-fallback", "", log
 
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+def build_network(on_cpu: bool, num_nodes: int = 20):
     from murmura_tpu.config import Config
     from murmura_tpu.utils.factories import build_network_from_config
 
-    num_nodes = 20
     cfg = Config.model_validate(
         {
             "experiment": {"name": "bench-krum-femnist", "seed": 7, "rounds": 10},
@@ -93,18 +128,44 @@ def main():
             },
         }
     )
+    return build_network_from_config(cfg)
 
-    network = build_network_from_config(cfg)
 
-    # Warmup: compile + 2 steady-state rounds.
-    network.train(rounds=3)
+def main():
+    backend, device_kind, probe_log = probe_backend()
+    on_cpu = "cpu" in backend
+    if on_cpu:
+        import jax
 
-    timed_rounds = 5 if on_cpu else 10
+        jax.config.update("jax_platforms", "cpu")
+
+    network = build_network(on_cpu)
+
+    # First round = compile + execute; two more to reach steady state.
+    t0 = time.perf_counter()
+    network.train(rounds=1)
+    compile_s = time.perf_counter() - t0
+    network.train(rounds=2)
+
+    timed_rounds = 5 if on_cpu else 20
     t0 = time.perf_counter()
     network.train(rounds=timed_rounds)
     elapsed = time.perf_counter() - t0
-
     rounds_per_sec = timed_rounds / elapsed
+    round_times = network.round_times[-timed_rounds:]
+
+    # MFU: XLA's own flop count for the whole fused round (local SGD +
+    # attack + exchange + Krum + eval) vs peak chip flops.
+    flops = mfu = None
+    try:
+        cost = network.step_cost_analysis()
+        flops = float(cost.get("flops", 0.0)) or None
+        peak = _peak_flops(device_kind)
+        if flops and peak:
+            mfu = round(flops * rounds_per_sec / peak, 4)
+    except Exception:
+        pass
+
     print(
         json.dumps(
             {
@@ -113,6 +174,16 @@ def main():
                 "unit": "rounds/sec",
                 "vs_baseline": round(rounds_per_sec / 50.0, 4),
                 "backend": backend,
+                "device_kind": device_kind,
+                "probe_log": probe_log,
+                "compile_s": round(compile_s, 2),
+                "round_ms": {
+                    "mean": round(1e3 * sum(round_times) / len(round_times), 2),
+                    "min": round(1e3 * min(round_times), 2),
+                    "max": round(1e3 * max(round_times), 2),
+                },
+                "flops_per_round": flops,
+                "mfu": mfu,
             }
         )
     )

@@ -1,0 +1,157 @@
+"""Node-scaling benchmark: 8 -> 256 FL nodes on one chip.
+
+The north-star scaling axis (BASELINE.json; SURVEY.md §7 memory-at-scale
+note): rounds/sec and peak device memory for
+``nodes in {8, 64, 256} x {krum/allgather, balance/ppermute}``, all nodes
+resident on a single chip.  krum/allgather is the O(N) dense-exchange
+worst case (every node sees the full [N, P] tensor and a global N x N
+distance matrix); balance/ppermute is the O(degree) circulant path that is
+the intended large-N configuration.
+
+Each point runs in its OWN subprocess: peak memory stats start clean, and
+an OOM kills the point, not the harness.  On TPU the flagship ~6.5M-param
+CNN is used with tpu.param_dtype=bfloat16 (the intended large-N setting —
+halves the resident [N, P] state); on the CPU fallback the tiny variant
+keeps each point tractable on one core.
+
+Writes bench_scaling.json (committed) and prints it.
+"""
+
+import argparse
+import json
+import resource
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+POINTS = [
+    {"nodes": n, "algo": algo, "exchange": exch}
+    for n in (8, 64, 256)
+    for algo, exch in (("krum", "allgather"), ("balance", "ppermute"))
+]
+
+
+def run_point(nodes: int, algo: str, exchange: str, on_cpu: bool) -> None:
+    """Child-process body: one scaling point, one JSON line on stdout."""
+    import jax
+
+    if on_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    from murmura_tpu.config import Config
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    agg_params = (
+        {"num_compromised": max(1, nodes // 10)} if algo == "krum"
+        else {"gamma": 2.0}
+    )
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": f"scale-{algo}-{nodes}", "seed": 7,
+                           "rounds": 4},
+            "topology": {"type": "k-regular", "num_nodes": nodes, "k": 4},
+            "aggregation": {"algorithm": algo, "params": agg_params},
+            "attack": {"enabled": True, "type": "gaussian", "percentage": 0.1,
+                        "params": {"noise_std": 10.0}},
+            "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+            "data": {
+                "adapter": "synthetic",
+                "params": {"num_samples": 64 * nodes,
+                           "input_shape": [28, 28, 1], "num_classes": 62},
+            },
+            "model": {
+                "factory": "examples.leaf.LEAFFEMNISTModel",
+                "params": {"variant": "tiny"} if on_cpu else {},
+            },
+            "backend": "tpu",
+            "tpu": {
+                "num_devices": 1,
+                "compute_dtype": "float32" if on_cpu else "bfloat16",
+                "param_dtype": "float32" if on_cpu else "bfloat16",
+                "exchange": exchange,
+            },
+        }
+    )
+    network = build_network_from_config(cfg)
+
+    t0 = time.perf_counter()
+    network.train(rounds=1)  # compile + first round
+    compile_s = time.perf_counter() - t0
+
+    timed = 2 if on_cpu else 5
+    t0 = time.perf_counter()
+    network.train(rounds=timed)
+    rounds_per_sec = timed / (time.perf_counter() - t0)
+
+    mem = {}
+    stats = jax.local_devices()[0].memory_stats() or {}
+    if "peak_bytes_in_use" in stats:
+        mem["peak_device_bytes"] = int(stats["peak_bytes_in_use"])
+    # Host-side peak RSS (the only signal on the CPU fallback).
+    mem["peak_host_rss_bytes"] = resource.getrusage(
+        resource.RUSAGE_SELF
+    ).ru_maxrss * 1024
+
+    print(json.dumps({
+        "nodes": nodes,
+        "algo": algo,
+        "exchange": exchange,
+        "rounds_per_sec": round(rounds_per_sec, 4),
+        "compile_s": round(compile_s, 1),
+        "model_dim": int(network.program.model_dim),
+        **mem,
+    }))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--point", nargs=3, metavar=("NODES", "ALGO", "EXCHANGE"),
+                    default=None, help="internal: run one point in-process")
+    ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--out", default=str(Path(__file__).parent /
+                                          "bench_scaling.json"))
+    args = ap.parse_args()
+
+    if args.point:
+        run_point(int(args.point[0]), args.point[1], args.point[2], args.cpu)
+        return
+
+    from bench import probe_backend
+
+    backend, device_kind, probe_log = probe_backend()
+    on_cpu = "cpu" in backend
+
+    results = []
+    for p in POINTS:
+        cmd = [sys.executable, __file__, "--point", str(p["nodes"]),
+               p["algo"], p["exchange"]]
+        if on_cpu:
+            cmd.append("--cpu")
+        print(f"[{p['nodes']:>3} nodes {p['algo']}/{p['exchange']}] ...",
+              file=sys.stderr, flush=True)
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=args.timeout)
+            if proc.returncode == 0 and proc.stdout.strip():
+                results.append(json.loads(proc.stdout.strip().splitlines()[-1]))
+            else:
+                results.append({**p, "ok": False, "rc": proc.returncode,
+                                "err": (proc.stderr or "")[-500:]})
+        except subprocess.TimeoutExpired:
+            results.append({**p, "ok": False,
+                            "err": f"timeout after {args.timeout}s"})
+
+    blob = {
+        "backend": backend,
+        "device_kind": device_kind,
+        "probe_log": probe_log,
+        "points": results,
+    }
+    Path(args.out).write_text(json.dumps(blob, indent=2) + "\n")
+    print(json.dumps(blob))
+
+
+if __name__ == "__main__":
+    main()

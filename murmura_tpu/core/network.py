@@ -99,6 +99,27 @@ class Network:
             return self.mobility.adjacency_at(round_idx).astype(np.float32)
         return self.topology.mask()
 
+    def step_cost_analysis(self) -> Dict[str, float]:
+        """XLA cost analysis of the compiled round step (flops, bytes).
+
+        Uses the AOT path on the same shapes ``train`` runs, so the compile
+        cache is hit and nothing executes.  Basis for the bench's MFU
+        estimate (flops/round x rounds/sec / peak chip flops).
+        """
+        args = (
+            self.params,
+            self.agg_state,
+            jax.random.PRNGKey(0),
+            jnp.asarray(self._adjacency_for_round(self.current_round)),
+            jnp.asarray(self.compromised),
+            jnp.asarray(0.0, dtype=jnp.float32),
+            self._data,
+        )
+        cost = self._step.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
+            cost = cost[0] if cost else {}
+        return dict(cost or {})
+
     def train(
         self,
         rounds: int,

@@ -1,0 +1,81 @@
+"""Worker script for the 2-process jax.distributed test.
+
+Launched as a subprocess (one per process id) by
+tests/test_multiprocess.py.  Joins a multi-process CPU run via
+``tpu.multihost`` config (exercising parallel.mesh.init_multihost through
+the factory path), trains one round of the sharded round step with the
+global mesh spanning both processes, and writes its replicated history row
+to a JSON file the test compares across processes.
+
+Usage: python multihost_worker.py <coordinator> <num_procs> <proc_id> <out>
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    coordinator, num_procs, proc_id, out_path = sys.argv[1:5]
+
+    # 4 virtual CPU devices per process -> 8-device global mesh.
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from murmura_tpu.config import Config
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": "multihost-test", "seed": 3, "rounds": 1},
+            "topology": {"type": "ring", "num_nodes": 8},
+            "aggregation": {"algorithm": "fedavg"},
+            "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+            "data": {
+                "adapter": "synthetic",
+                "params": {"num_samples": 320, "input_dim": 12,
+                           "num_classes": 3},
+            },
+            "model": {
+                "factory": "mlp",
+                "params": {"input_dim": 12, "hidden_dims": [16],
+                           "num_classes": 3},
+            },
+            "backend": "tpu",
+            "tpu": {
+                "multihost": True,
+                "coordinator_address": coordinator,
+                "num_processes": int(num_procs),
+                "process_id": int(proc_id),
+                "compute_dtype": "float32",
+            },
+        }
+    )
+    network = build_network_from_config(cfg)
+    assert jax.process_count() == int(num_procs), jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+    assert network.mesh.devices.size == 8
+
+    history = network.train(rounds=1)
+    with open(out_path, "w") as f:
+        json.dump(
+            {
+                "process_id": int(proc_id),
+                "process_count": jax.process_count(),
+                "global_devices": jax.device_count(),
+                "mean_accuracy": history["mean_accuracy"],
+                "mean_loss": history["mean_loss"],
+            },
+            f,
+        )
+
+
+if __name__ == "__main__":
+    main()

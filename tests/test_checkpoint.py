@@ -99,3 +99,19 @@ def test_round_counter_persists_across_train_calls():
     net.train(rounds=2)
     assert net.current_round == 4
     assert net.history["round"] == [1, 2, 3, 4]
+
+
+def test_defer_metrics_history_identical():
+    """Throughput mode (defer_metrics=True) must record the exact same
+    history as the per-round sync path."""
+    sync = _make_network()
+    sync.train(rounds=4)
+    deferred = _make_network()
+    deferred.train(rounds=4, defer_metrics=True)
+    assert sync.history["round"] == deferred.history["round"]
+    np.testing.assert_allclose(
+        sync.history["mean_accuracy"], deferred.history["mean_accuracy"]
+    )
+    np.testing.assert_allclose(
+        sync.history["mean_loss"], deferred.history["mean_loss"]
+    )

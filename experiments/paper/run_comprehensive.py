@@ -99,6 +99,9 @@ def main():
     ap.add_argument("--timeout", type=float, default=3600.0)
     ap.add_argument("--limit", type=int, default=None,
                     help="Run at most N configs (smoke testing)")
+    ap.add_argument("--jobs", type=int, default=1,
+                    help="Concurrent experiment subprocesses (use ~nproc; "
+                         "each experiment is single-threaded on CPU)")
     args = ap.parse_args()
 
     RESULTS_DIR.mkdir(parents=True, exist_ok=True)
@@ -118,18 +121,40 @@ def main():
         if args.limit:
             cfgs = cfgs[: args.limit]
         done = {r["config"] for r in records if r.get("ok")}
-        for i, cfg in enumerate(cfgs):
-            rel = str(cfg.relative_to(CONFIG_DIR))
-            if rel in done:
-                continue
+        todo = [c for c in cfgs if str(c.relative_to(CONFIG_DIR)) not in done]
+
+        def out_path(rel: str) -> Path:
             out = RESULTS_DIR / "histories" / rel.replace("/", "_").replace(
                 ".yaml", ".json"
             )
             out.parent.mkdir(parents=True, exist_ok=True)
-            print(f"[{i + 1}/{len(cfgs)}] {rel}", flush=True)
-            records = [r for r in records if r["config"] != rel]
-            records.append(run_one(cfg, out, args.timeout))
-            results_file.write_text(json.dumps(records, indent=2))
+            return out
+
+        if args.jobs <= 1:
+            for i, cfg in enumerate(todo):
+                rel = str(cfg.relative_to(CONFIG_DIR))
+                print(f"[{i + 1}/{len(todo)}] {rel}", flush=True)
+                records = [r for r in records if r["config"] != rel]
+                records.append(run_one(cfg, out_path(rel), args.timeout))
+                results_file.write_text(json.dumps(records, indent=2))
+        else:
+            from concurrent.futures import ThreadPoolExecutor, as_completed
+
+            with ThreadPoolExecutor(max_workers=args.jobs) as pool:
+                futs = {
+                    pool.submit(
+                        run_one, cfg,
+                        out_path(str(cfg.relative_to(CONFIG_DIR))),
+                        args.timeout,
+                    ): str(cfg.relative_to(CONFIG_DIR))
+                    for cfg in todo
+                }
+                for i, fut in enumerate(as_completed(futs)):
+                    rel = futs[fut]
+                    print(f"[{i + 1}/{len(todo)}] {rel}", flush=True)
+                    records = [r for r in records if r["config"] != rel]
+                    records.append(fut.result())
+                    results_file.write_text(json.dumps(records, indent=2))
 
     (PAPER_DIR / "RESULTS_SUMMARY.md").write_text(summarize(records))
     ok = sum(1 for r in records if r.get("ok"))

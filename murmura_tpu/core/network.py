@@ -56,18 +56,20 @@ class Network:
         )
 
         if backend == "tpu":
-            from murmura_tpu.parallel.mesh import shard_step
+            from murmura_tpu.parallel.mesh import shard_eval_step, shard_step
 
             if mesh is None:
                 from murmura_tpu.parallel.mesh import make_mesh
 
                 mesh = make_mesh()
             self.mesh = mesh
-            self._step = shard_step(program.step, program, mesh, donate=donate)
+            self._step = shard_step(program.train_step, program, mesh, donate=donate)
+            self._eval = shard_eval_step(program.eval_step, program, mesh)
         else:
             self.mesh = None
             donate_argnums = (0, 1) if donate else ()
-            self._step = jax.jit(program.step, donate_argnums=donate_argnums)
+            self._step = jax.jit(program.train_step, donate_argnums=donate_argnums)
+            self._eval = jax.jit(program.eval_step)
 
         # Mutable run state
         self.params = program.init_params
@@ -100,11 +102,13 @@ class Network:
         return self.topology.mask()
 
     def step_cost_analysis(self) -> Dict[str, float]:
-        """XLA cost analysis of the compiled round step (flops, bytes).
+        """XLA cost analysis of the compiled train step (flops, bytes).
 
         Uses the AOT path on the same shapes ``train`` runs, so the compile
         cache is hit and nothing executes.  Basis for the bench's MFU
-        estimate (flops/round x rounds/sec / peak chip flops).
+        estimate (flops/round x rounds/sec / peak chip flops).  Covers the
+        per-round program only — eval is compiled separately and runs on the
+        ``eval_every`` cadence, so its flops are not part of a round.
         """
         args = (
             self.params,
@@ -131,9 +135,10 @@ class Network:
     ) -> Dict[str, List[Any]]:
         """Run the FL rounds (reference: network.py:60-94).
 
-        Note: evaluation metrics are computed inside the fused round step at
-        every round; ``eval_every`` controls which rounds are *recorded*,
-        matching the reference's eval cadence semantics.
+        Evaluation is a separately compiled program run only on rounds that
+        are recorded (``eval_every``) — unlike the reference, whose loop
+        evaluates every round (network.py:141-199), skipped-eval rounds pay
+        zero eval FLOPs here.
 
         Args:
             checkpoint_dir: if set, write a checkpoint after every
@@ -170,7 +175,7 @@ class Network:
             t0 = time.perf_counter()
             adj = jnp.asarray(self._adjacency_for_round(round_idx))
             self._rng, step_key = jax.random.split(self._rng)
-            self.params, self.agg_state, metrics = self._step(
+            self.params, self.agg_state, agg_metrics = self._step(
                 self.params,
                 self.agg_state,
                 step_key,
@@ -181,6 +186,7 @@ class Network:
             )
             self.current_round = round_idx + 1
             if self.current_round % eval_every == 0:
+                metrics = {**self._eval(self.params, self._data), **agg_metrics}
                 if defer_metrics:
                     pending.append((self.current_round, metrics))
                 else:

@@ -62,9 +62,17 @@ DMTT_STATE_KEYS = (
 
 @dataclass(frozen=True)
 class RoundProgram:
-    """A compiled round step plus the pieces needed to drive it."""
+    """A compiled round step plus the pieces needed to drive it.
 
-    step: Callable  # (params, agg_state, key, adj, compromised, round_idx, data)
+    ``train_step`` is the per-round program (local SGD + attack + exchange +
+    aggregation); ``eval_step`` is the full test-set sweep, compiled
+    separately so the orchestrator pays for it only on recorded rounds
+    (``eval_every``) instead of fusing it into every round the way the
+    reference's loop does (murmura/core/network.py:80-94).
+    """
+
+    train_step: Callable  # (params, agg_state, key, adj, compromised, round_idx, data)
+    eval_step: Callable  # (params, data) -> eval metrics
     init_params: Any  # stacked [N, ...] pytree
     init_agg_state: Dict[str, np.ndarray]
     data_arrays: Dict[str, np.ndarray]
@@ -280,7 +288,7 @@ def build_round_program(
     attack_apply = attack.apply if attack is not None else None
     claims_fn = attack.claims_fn if attack is not None else None
 
-    def round_step(params, agg_state, key, adj, compromised, round_idx, d):
+    def train_round(params, agg_state, key, adj, compromised, round_idx, d):
         train_key, attack_key = jax.random.split(key)
         honest = 1.0 - compromised
 
@@ -342,11 +350,14 @@ def build_round_program(
         agg_state = {**agg_state, **rule_state}
         params = jax.vmap(unravel)(new_flat)
 
-        # 4. evaluation (network.py:141-199)
-        metrics = evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
-        metrics.update({f"agg_{k}": v for k, v in agg_stats.items()})
+        metrics = {f"agg_{k}": v for k, v in agg_stats.items()}
         metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
         return params, agg_state, metrics
+
+    def eval_step(params, d):
+        # evaluation (network.py:141-199) — held-out arrays when the data
+        # loader provided them (eval_arrays), else the training shard.
+        return evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
 
     init_agg_state = {
         k: np.asarray(v) for k, v in agg.init_state(n).items()
@@ -357,7 +368,8 @@ def build_round_program(
         )
 
     return RoundProgram(
-        step=round_step,
+        train_step=train_round,
+        eval_step=eval_step,
         init_params=init_params,
         init_agg_state=init_agg_state,
         data_arrays=data_arrays,

@@ -119,3 +119,21 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
         out_shardings=(params_s, agg_s, repl),
         donate_argnums=donate_argnums,
     )
+
+
+def shard_eval_step(eval_step, program, mesh: Mesh):
+    """Jit a RoundProgram eval step (params, data) -> metrics over ``mesh``.
+
+    Compiled separately from the train step so the orchestrator only pays
+    the full test-set sweep on recorded rounds (``eval_every``).  Metrics
+    come out replicated for the same multi-host device_get reason as
+    :func:`shard_step`.
+    """
+    node_s, repl = make_shardings(mesh)
+    params_s = _shard_leading_axis(program.init_params, node_s, repl)
+    data_s = _shard_leading_axis(program.data_arrays, node_s, repl)
+    return jax.jit(
+        eval_step,
+        in_shardings=(params_s, data_s),
+        out_shardings=repl,
+    )

@@ -29,10 +29,21 @@ POINTS = [
     {"nodes": n, "algo": algo, "exchange": exch}
     for n in (8, 64, 256)
     for algo, exch in (("krum", "allgather"), ("balance", "ppermute"))
+] + [
+    # 1024-node Krum: the O(N^3)-fix acceptance point (round-2 verdict
+    # task 6).  The ~800K-param "small" CNN keeps own+gathered [N, P]
+    # state inside one chip's HBM at N=1024 (the flagship 6.5M model's
+    # gathered tensor alone would be ~13 GB in bf16).
+    {"nodes": 1024, "algo": "krum", "exchange": "allgather",
+     "variant": "small"},
+    {"nodes": 1024, "algo": "balance", "exchange": "ppermute",
+     "variant": "small"},
 ]
 
 
-def run_point(nodes: int, algo: str, exchange: str, on_cpu: bool) -> None:
+def run_point(
+    nodes: int, algo: str, exchange: str, on_cpu: bool, variant: str = ""
+) -> None:
     """Child-process body: one scaling point, one JSON line on stdout."""
     import jax
 
@@ -43,9 +54,16 @@ def run_point(nodes: int, algo: str, exchange: str, on_cpu: bool) -> None:
     from murmura_tpu.utils.factories import build_network_from_config
 
     agg_params = (
-        {"num_compromised": max(1, nodes // 10)} if algo == "krum"
+        # Krum requires c < (m-2)/2 with m = degree+2 candidates; on the
+        # k=4 graph that caps usable c at 1 regardless of N.
+        {"num_compromised": 1} if algo == "krum"
         else {"gamma": 2.0}
     )
+    model_params = {}
+    if on_cpu:
+        model_params["variant"] = "tiny"
+    elif variant:
+        model_params["variant"] = variant
     cfg = Config.model_validate(
         {
             "experiment": {"name": f"scale-{algo}-{nodes}", "seed": 7,
@@ -62,7 +80,7 @@ def run_point(nodes: int, algo: str, exchange: str, on_cpu: bool) -> None:
             },
             "model": {
                 "factory": "examples.leaf.LEAFFEMNISTModel",
-                "params": {"variant": "tiny"} if on_cpu else {},
+                "params": model_params,
             },
             "backend": "tpu",
             "tpu": {
@@ -81,7 +99,9 @@ def run_point(nodes: int, algo: str, exchange: str, on_cpu: bool) -> None:
 
     timed = 2 if on_cpu else 5
     t0 = time.perf_counter()
-    network.train(rounds=timed)
+    # Same throughput conventions as bench.py: deferred metrics (no host
+    # sync in the loop) and eval only on the last timed round.
+    network.train(rounds=timed, defer_metrics=True, eval_every=timed)
     rounds_per_sec = timed / (time.perf_counter() - t0)
 
     mem = {}
@@ -97,6 +117,8 @@ def run_point(nodes: int, algo: str, exchange: str, on_cpu: bool) -> None:
         "nodes": nodes,
         "algo": algo,
         "exchange": exchange,
+        # Effective variant actually built (the CPU fallback forces tiny).
+        "variant": model_params.get("variant", "baseline"),
         "rounds_per_sec": round(rounds_per_sec, 4),
         "compile_s": round(compile_s, 1),
         "model_dim": int(network.program.model_dim),
@@ -108,6 +130,8 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--point", nargs=3, metavar=("NODES", "ALGO", "EXCHANGE"),
                     default=None, help="internal: run one point in-process")
+    ap.add_argument("--variant", default="",
+                    help="internal: model variant override for --point")
     ap.add_argument("--cpu", action="store_true")
     ap.add_argument("--timeout", type=float, default=1800.0)
     ap.add_argument("--out", default=str(Path(__file__).parent /
@@ -115,7 +139,8 @@ def main():
     args = ap.parse_args()
 
     if args.point:
-        run_point(int(args.point[0]), args.point[1], args.point[2], args.cpu)
+        run_point(int(args.point[0]), args.point[1], args.point[2], args.cpu,
+                  variant=args.variant)
         return
 
     from bench import probe_backend
@@ -127,6 +152,8 @@ def main():
     for p in POINTS:
         cmd = [sys.executable, __file__, "--point", str(p["nodes"]),
                p["algo"], p["exchange"]]
+        if p.get("variant"):
+            cmd += ["--variant", p["variant"]]
         if on_cpu:
             cmd.append("--cpu")
         print(f"[{p['nodes']:>3} nodes {p['algo']}/{p['exchange']}] ...",

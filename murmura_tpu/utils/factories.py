@@ -160,6 +160,19 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
                 f"(ring/k-regular); '{config.topology.type}' is not"
             )
         agg_params["exchange_offsets"] = offsets
+    if (
+        config.aggregation.algorithm == "krum"
+        and mobility is None
+        and config.dmtt is None
+    ):
+        # Static graph: bound Krum's per-node candidate block at
+        # max-degree+1 so the vmapped selection gathers [N, m, m] instead
+        # of sorting per-node [N, N] copies (O(N^3) at m = N).  Dynamic
+        # graphs (mobility/DMTT TopB) have no static degree bound and keep
+        # the dense default.
+        agg_params.setdefault(
+            "max_candidates", int(topology.mask().sum(axis=1).max()) + 1
+        )
     if config.aggregation.algorithm == "evidential_trust":
         probe_size = int(agg_params.get("max_eval_samples", 100))
     else:
@@ -183,7 +196,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
     if config.dmtt is not None:
         from murmura_tpu.dmtt.protocol import DMTTParams
 
-        dmtt = DMTTParams(**config.dmtt.model_dump())
+        dmtt = DMTTParams(**config.dmtt.model_dump(exclude={"allow_static"}))
 
     program = build_round_program(
         model,

@@ -111,6 +111,28 @@ class TestKrum:
         # (its own true state is close to 1..3)
         assert np.abs(np.asarray(new)[0]).max() < 1.0
 
+    def test_capped_candidates_match_dense(self):
+        """The O(N·m²) gathered-candidate path (max_candidates = degree+1,
+        injected by the factories for static graphs) must select exactly what
+        the dense m = N path selects."""
+        rng = np.random.default_rng(3)
+        n = 12
+        own = rng.normal(size=(n, 16)).astype(np.float32)
+        bcast = own + rng.normal(size=(n, 16)).astype(np.float32) * 0.1
+        bcast[5] += 50.0  # one Byzantine broadcast
+        for adj in (_ring_adj(n), _full_adj(n)):
+            max_deg = int(np.asarray(adj).sum(axis=1).max())
+            dense = build_aggregator("krum", {"num_compromised": 1})
+            capped = build_aggregator(
+                "krum", {"num_compromised": 1, "max_candidates": max_deg + 1}
+            )
+            new_d, _, st_d = _run(dense, own, adj, bcast=bcast)
+            new_c, _, st_c = _run(capped, own, adj, bcast=bcast)
+            np.testing.assert_array_equal(
+                np.asarray(st_d["selected_index"]), np.asarray(st_c["selected_index"])
+            )
+            np.testing.assert_allclose(np.asarray(new_d), np.asarray(new_c), atol=1e-6)
+
 
 class TestBalance:
     def test_threshold_filters_outlier(self):

@@ -13,6 +13,12 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
+# Fraction of each node's shard carved off for held-out evaluation by every
+# loader that has no dataset-provided test split.  The reference evaluates
+# on training data (murmura/core/network.py:289-294);
+# ``data.params.holdout_fraction: 0.0`` restores that behavior.
+DEFAULT_HOLDOUT_FRACTION = 0.2
+
 
 @dataclass
 class FederatedArrays:
@@ -69,6 +75,41 @@ class FederatedArrays:
         ``DatasetAdapter.get_client_data`` parity (murmura/data/adapters.py:30-52)."""
         n = int(self.num_samples[node_id])
         return self.x[node_id, :n], self.y[node_id, :n]
+
+
+def split_holdout(
+    partitions: Sequence[Sequence[int]],
+    fraction: float,
+    seed: int,
+    min_train: int = 2,
+):
+    """Split each node's index list into paired (train, test) lists.
+
+    The reference evaluates on training data for most adapters
+    (murmura/core/network.py:289-294); the paired per-node split mirrors its
+    LEAF per-user train/test pairing (murmura/examples/leaf/
+    datasets.py:300-377) for every loader, so held-out accuracy keeps the
+    node's own (non-IID) label distribution.  Nodes keep at least
+    ``min_train`` training samples (the reference's effective-batch floor,
+    network.py:278-287); a node too small to spare any test samples
+    evaluates on its training shard (reference behavior) so its accuracy
+    row stays meaningful instead of dividing by an empty mask.
+    """
+    rng = np.random.default_rng(seed)
+    train: List[List[int]] = []
+    test: List[List[int]] = []
+    for p in partitions:
+        p = list(p)
+        n_test = int(round(len(p) * fraction))
+        n_test = min(n_test, max(0, len(p) - min_train))
+        order = rng.permutation(len(p))
+        if n_test == 0:
+            train.append(p)
+            test.append(p)
+        else:
+            test.append([p[i] for i in order[:n_test]])
+            train.append([p[i] for i in order[n_test:]])
+    return train, test
 
 
 def stack_partitions(

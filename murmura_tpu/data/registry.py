@@ -11,7 +11,12 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from murmura_tpu.data.base import FederatedArrays, stack_partitions
+from murmura_tpu.data.base import (
+    DEFAULT_HOLDOUT_FRACTION,
+    FederatedArrays,
+    split_holdout,
+    stack_partitions,
+)
 from murmura_tpu.data.partitioners import dirichlet_partition, iid_partition
 from murmura_tpu.data.synthetic import make_synthetic, make_synthetic_sequences
 
@@ -28,6 +33,14 @@ def _partition(labels: np.ndarray, num_nodes: int, params: Dict[str, Any], seed:
     if method == "iid":
         return iid_partition(len(labels), num_nodes, seed=seed)
     raise ValueError(f"Unknown partition_method: {method}")
+
+
+def _with_holdout(parts, params: Dict[str, Any], seed: int):
+    """(train_partitions, test_partitions|None) per data.params.holdout_fraction."""
+    frac = float(params.get("holdout_fraction", DEFAULT_HOLDOUT_FRACTION))
+    if frac <= 0.0:
+        return parts, None
+    return split_holdout(parts, frac, seed)
 
 
 def build_federated_data(
@@ -49,9 +62,11 @@ def build_federated_data(
             seed=seed,
         )
         parts = _partition(y, num_nodes, params, seed)
+        parts, test_parts = _with_holdout(parts, params, seed)
         return stack_partitions(
             x, y, parts, max_samples=max_samples,
             num_classes=int(params.get("num_classes", 10)),
+            test_partitions=test_parts,
         )
 
     if adapter in ("synthetic_sequences", "synthetic_seq"):
@@ -62,9 +77,11 @@ def build_federated_data(
             seed=seed,
         )
         parts = _partition(y, num_nodes, params, seed)
+        parts, test_parts = _with_holdout(parts, params, seed)
         return stack_partitions(
             x, y, parts, max_samples=max_samples,
             num_classes=int(params.get("vocab_size", 81)),
+            test_partitions=test_parts,
         )
 
     if adapter.startswith("leaf."):

@@ -12,13 +12,22 @@ from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
-from murmura_tpu.data.base import FederatedArrays, stack_partitions
+from murmura_tpu.data.base import (
+    DEFAULT_HOLDOUT_FRACTION,
+    FederatedArrays,
+    split_holdout,
+    stack_partitions,
+)
 from murmura_tpu.data.partitioners import (
     dirichlet_partition,
     iid_partition,
     natural_partition,
 )
 from murmura_tpu.data.synthetic import make_synthetic
+
+# UCI HAR prefers its official on-disk test split over a carved holdout
+# (reference adapter's split arg: murmura/examples/wearables/adapter.py:25);
+# holdout_fraction: 0.0 disables held-out eval entirely.
 
 # (input_dim, num_classes, num_subjects) — reference: wearables/datasets.py
 # and models.py:195-300 (UCI HAR 561; PAMAP2 100-sample window x 40 features;
@@ -223,10 +232,25 @@ def load_wearable_federated(
     elif dataset == "ppg_dalia":
         input_dim = int(params.get("window_size", 32)) * 6
 
+    holdout = float(params.get("holdout_fraction", DEFAULT_HOLDOUT_FRACTION))
     x = y = subjects = None
+    x_heldout = y_heldout = subjects_heldout = None
     if data_path and Path(data_path).exists():
         if dataset == "uci_har":
             x, y, subjects = _load_uci_har(Path(data_path), split)
+            if split == "train" and holdout > 0.0:
+                # Official held-out split (the reference adapter only ever
+                # loads one split and evaluates on it); partitioned onto
+                # nodes below with the same method as train.  UCI HAR test
+                # subjects are disjoint from train subjects, so under
+                # natural partitioning a node's test shard comes from
+                # different people — the harder, standard HAR protocol.
+                try:
+                    x_heldout, y_heldout, subjects_heldout = _load_uci_har(
+                        Path(data_path), "test"
+                    )
+                except OSError:
+                    pass
         elif dataset == "pamap2":
             x, y, subjects = _load_pamap2(Path(data_path), params)
         elif dataset == "ppg_dalia":
@@ -248,21 +272,35 @@ def load_wearable_federated(
         subjects = rng.integers(0, num_subjects, size=n_total)
 
     method = params.get("partition_method", "dirichlet")
-    if method == "dirichlet":
-        parts = dirichlet_partition(
-            y, num_nodes, alpha=float(params.get("alpha", 0.5)), seed=seed
-        )
-    elif method == "iid":
-        parts = iid_partition(len(y), num_nodes, seed=seed)
-    elif method == "natural":
-        nat, actual = natural_partition(subjects)
-        # Fold natural subject groups round-robin onto the requested nodes.
-        parts = [[] for _ in range(num_nodes)]
-        for g, p in enumerate(nat):
-            parts[g % num_nodes].extend(p)
-    else:
+
+    def _make_parts(yy, subs):
+        if method == "dirichlet":
+            return dirichlet_partition(
+                yy, num_nodes, alpha=float(params.get("alpha", 0.5)), seed=seed
+            )
+        if method == "iid":
+            return iid_partition(len(yy), num_nodes, seed=seed)
+        if method == "natural":
+            nat, _actual = natural_partition(subs)
+            # Fold natural subject groups round-robin onto the requested nodes.
+            parts = [[] for _ in range(num_nodes)]
+            for g, p in enumerate(nat):
+                parts[g % num_nodes].extend(p)
+            return parts
         raise ValueError(f"Unknown partition_method: {method}")
 
+    parts = _make_parts(y, subjects)
+    if x_heldout is not None:
+        # Official test split, partitioned onto nodes by the same method.
+        test_parts = _make_parts(y_heldout, subjects_heldout)
+        return stack_partitions(
+            x, y, parts, max_samples=max_samples, num_classes=num_classes,
+            test_partitions=test_parts, x_test=x_heldout, y_test=y_heldout,
+        )
+    test_parts = None
+    if holdout > 0.0:
+        parts, test_parts = split_holdout(parts, holdout, seed)
     return stack_partitions(
-        x, y, parts, max_samples=max_samples, num_classes=num_classes
+        x, y, parts, max_samples=max_samples, num_classes=num_classes,
+        test_partitions=test_parts,
     )

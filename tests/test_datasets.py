@@ -58,7 +58,7 @@ def test_pamap2_loader(pamap2_dir):
     fa = load_wearable_federated(
         "pamap2",
         {"data_path": str(pamap2_dir), "window_size": 100, "window_stride": 50,
-         "partition_method": "iid"},
+         "partition_method": "iid", "holdout_fraction": 0.0},
         num_nodes=2,
         seed=0,
     )
@@ -109,7 +109,8 @@ def ppg_dir(tmp_path):
 def test_ppg_dalia_loader(ppg_dir):
     fa = load_wearable_federated(
         "ppg_dalia",
-        {"data_path": str(ppg_dir), "partition_method": "iid"},
+        {"data_path": str(ppg_dir), "partition_method": "iid",
+         "holdout_fraction": 0.0},
         num_nodes=2,
         seed=0,
     )
@@ -141,7 +142,9 @@ def shakespeare_dir(tmp_path):
 
 def test_shakespeare_loader(shakespeare_dir):
     fa = load_leaf_federated(
-        "shakespeare", {"data_path": str(shakespeare_dir)}, num_nodes=2, seed=0
+        "shakespeare",
+        {"data_path": str(shakespeare_dir), "holdout_fraction": 0.0},
+        num_nodes=2, seed=0
     )
     assert fa.x.shape[-1] == 80
     assert fa.num_classes == SHAKESPEARE_VOCAB
@@ -183,7 +186,9 @@ def celeba_dir(tmp_path):
 
 def test_celeba_loader(celeba_dir):
     fa = load_leaf_federated(
-        "celeba", {"data_path": str(celeba_dir)}, num_nodes=2, seed=0
+        "celeba",
+        {"data_path": str(celeba_dir), "holdout_fraction": 0.0},
+        num_nodes=2, seed=0
     )
     assert fa.x.shape[-3:] == (84, 84, 3)  # NHWC, resized
     assert fa.num_classes == 2

@@ -1,0 +1,187 @@
+"""Held-out evaluation plumbing (round-3): every loader can emit paired
+per-node train/test shards, and the round program evaluates on them
+(the reference evaluates on training data for everything except LEAF's
+paired per-user splits — murmura/core/network.py:289-294,
+murmura/examples/leaf/datasets.py:300-377)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from murmura_tpu.data.base import split_holdout, stack_partitions
+from murmura_tpu.data.leaf import load_leaf_federated
+from murmura_tpu.data.registry import build_federated_data
+from murmura_tpu.data.wearables import load_wearable_federated
+
+
+class TestSplitHoldout:
+    def test_disjoint_and_paired(self):
+        parts = [list(range(0, 50)), list(range(50, 100))]
+        train, test = split_holdout(parts, 0.2, seed=0)
+        for i, p in enumerate(parts):
+            assert len(test[i]) == 10
+            assert len(train[i]) == 40
+            assert set(train[i]) | set(test[i]) == set(p)
+            assert not set(train[i]) & set(test[i])
+
+    def test_small_node_falls_back_to_train_eval(self):
+        # 2 samples: carving a test sample would leave < min_train, so the
+        # node evaluates on its training shard (reference behavior).
+        train, test = split_holdout([[7, 9]], 0.5, seed=0)
+        assert train[0] == [7, 9]
+        assert test[0] == [7, 9]
+
+    def test_zero_fraction_not_used_by_loaders(self):
+        fa = build_federated_data(
+            "synthetic",
+            {"num_samples": 100, "input_dim": 4, "num_classes": 3,
+             "holdout_fraction": 0.0},
+            num_nodes=4,
+        )
+        assert fa.x_test is None
+        # eval_arrays falls back to the training shard
+        ex, ey, em = fa.eval_arrays
+        assert ex is fa.x
+
+
+class TestLoaderHoldout:
+    def test_synthetic_default_emits_disjoint_test(self):
+        fa = build_federated_data(
+            "synthetic",
+            {"num_samples": 200, "input_dim": 6, "num_classes": 4},
+            num_nodes=4,
+        )
+        assert fa.x_test is not None
+        assert int(fa.mask_test.sum()) > 0
+        # Disjoint: no test row equals any train row of the same node.
+        for i in range(4):
+            tr = fa.x[i][fa.mask[i] > 0]
+            te = fa.x_test[i][fa.mask_test[i] > 0]
+            d = np.abs(tr[:, None, :] - te[None, :, :]).sum(-1)
+            assert d.min() > 1e-9
+
+    def test_wearable_synthetic_fallback_emits_test(self):
+        fa = load_wearable_federated("uci_har", {"num_samples": 300}, num_nodes=5)
+        assert fa.x_test is not None and int(fa.mask_test.sum()) > 0
+
+    def test_leaf_synthetic_fallback_emits_test(self):
+        fa = load_leaf_federated("femnist", {"num_samples": 300}, num_nodes=5)
+        assert fa.x_test is not None and int(fa.mask_test.sum()) > 0
+
+
+class TestLeafPairedSplit:
+    @pytest.fixture
+    def leaf_dir(self, tmp_path):
+        """Tiny FEMNIST-layout dataset with paired train/test user shards."""
+        rng = np.random.default_rng(0)
+        for split, n_per_user in (("train", 6), ("test", 2)):
+            d = tmp_path / split
+            d.mkdir()
+            blob = {"users": [], "user_data": {}}
+            for u in range(4):
+                uid = f"user{u}"
+                blob["users"].append(uid)
+                blob["user_data"][uid] = {
+                    "x": rng.random((n_per_user, 784)).tolist(),
+                    # label = user id so shard provenance is checkable
+                    "y": [u] * n_per_user,
+                }
+            (d / "shard0.json").write_text(json.dumps(blob))
+        return tmp_path
+
+    def test_test_shard_holds_own_users_samples(self, leaf_dir):
+        fa = load_leaf_federated(
+            "femnist", {"data_path": str(leaf_dir)}, num_nodes=2, seed=3
+        )
+        assert fa.x_test is not None
+        assert fa.x_test.shape[1:] == (4, 28, 28, 1)  # 2 users x 2 test samples
+        for i in range(2):
+            train_labels = set(fa.y[i][fa.mask[i] > 0].tolist())
+            test_labels = set(fa.y_test[i][fa.mask_test[i] > 0].tolist())
+            # Paired per-user split: the same users (= labels here) on both
+            # sides, and both nodes' user sets are disjoint.
+            assert test_labels == train_labels
+        assert not (
+            set(fa.y[0][fa.mask[0] > 0].tolist())
+            & set(fa.y[1][fa.mask[1] > 0].tolist())
+        )
+
+    def test_node_without_test_users_falls_back_to_train(self, leaf_dir):
+        """A node whose users are absent from test/ evaluates on its train
+        shard instead of an empty mask (which would score it 0.0)."""
+        # Rewrite the test shard to cover users 0 and 2 only; with seed 3 and
+        # 2 nodes, one node ends up with no test users for at least one user.
+        blob = json.loads((leaf_dir / "test" / "shard0.json").read_text())
+        blob["users"] = ["user0"]
+        blob["user_data"] = {"user0": blob["user_data"]["user0"]}
+        (leaf_dir / "test" / "shard0.json").write_text(json.dumps(blob))
+
+        fa = load_leaf_federated(
+            "femnist", {"data_path": str(leaf_dir)}, num_nodes=2, seed=3
+        )
+        # user0 lives on exactly one node; the other node fell back to its
+        # training rows.
+        node_with_u0 = 0 if 0 in fa.y_test[0][fa.mask_test[0] > 0] else 1
+        other = 1 - node_with_u0
+        assert int(fa.mask_test[other].sum()) == int(fa.mask[other].sum())
+        got = fa.y_test[other][fa.mask_test[other] > 0]
+        want = fa.y[other][fa.mask[other] > 0]
+        np.testing.assert_array_equal(np.sort(got), np.sort(want))
+
+
+class TestUciHarOfficialSplit:
+    @pytest.fixture
+    def har_dir(self, tmp_path):
+        rng = np.random.default_rng(1)
+        for split, rows, subjects in (("train", 40, (1, 2)), ("test", 12, (9,))):
+            d = tmp_path / split
+            d.mkdir()
+            np.savetxt(d / f"X_{split}.txt", rng.normal(size=(rows, 561)))
+            np.savetxt(d / f"y_{split}.txt", rng.integers(1, 7, size=rows), fmt="%d")
+            subs = np.array(subjects)[np.arange(rows) % len(subjects)]
+            np.savetxt(d / f"subject_{split}.txt", subs, fmt="%d")
+        return tmp_path
+
+    def test_official_test_split_is_used(self, har_dir):
+        fa = load_wearable_federated(
+            "uci_har", {"data_path": str(har_dir), "partition_method": "iid"},
+            num_nodes=3,
+        )
+        assert fa.x_test is not None
+        # All 12 official test rows distributed over the nodes; train rows
+        # stay complete (no carve-out when the official split exists).
+        assert int(fa.mask_test.sum()) == 12
+        assert int(fa.mask.sum()) == 40
+
+    def test_holdout_zero_disables(self, har_dir):
+        fa = load_wearable_federated(
+            "uci_har",
+            {"data_path": str(har_dir), "partition_method": "iid",
+             "holdout_fraction": 0.0},
+            num_nodes=3,
+        )
+        assert fa.x_test is None
+
+
+class TestRoundProgramUsesHeldout:
+    def test_eval_arrays_wired_into_program(self):
+        from murmura_tpu.core.rounds import build_round_program
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.models.registry import build_model
+
+        fa = build_federated_data(
+            "synthetic",
+            {"num_samples": 120, "input_dim": 5, "num_classes": 3},
+            num_nodes=3,
+        )
+        model = build_model("mlp", {"input_dim": 5, "hidden_dims": [8],
+                                    "num_classes": 3})
+        agg = build_aggregator("fedavg", {})
+        prog = build_round_program(model, agg, fa, batch_size=8)
+        np.testing.assert_array_equal(prog.data_arrays["eval_x"], fa.x_test)
+        np.testing.assert_array_equal(prog.data_arrays["eval_y"], fa.y_test)
+        # ...and the train arrays are NOT the eval arrays.
+        assert prog.data_arrays["eval_x"].shape != prog.data_arrays["x"].shape or not np.array_equal(
+            prog.data_arrays["eval_x"], prog.data_arrays["x"]
+        )

@@ -15,6 +15,7 @@ Usage:
 
 import argparse
 import json
+import os
 import subprocess
 import sys
 import time
@@ -26,18 +27,28 @@ RESULTS_DIR = PAPER_DIR / "results"
 CATEGORIES = ["baseline", "heterogeneity", "attacks", "topologies", "ablation"]
 
 
-def run_one(cfg_path: Path, out_json: Path, timeout: float) -> dict:
+def run_one(cfg_path: Path, out_json: Path, timeout: float,
+            device: str = None) -> dict:
     """Run one experiment through the CLI; returns a result record."""
     t0 = time.time()
     record = {"config": str(cfg_path.relative_to(CONFIG_DIR))}
+    # Persistent XLA compilation cache: the matrix reuses a handful of
+    # program shapes across hundreds of subprocesses, so all but the first
+    # few runs skip compilation entirely.
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/murmura_jax_cache")
+    cmd = [sys.executable, "-m", "murmura_tpu", "run", str(cfg_path),
+           "-o", str(out_json), "--quiet"]
+    if device:
+        cmd += ["--device", device]
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "murmura_tpu", "run", str(cfg_path),
-             "-o", str(out_json), "--quiet"],
+            cmd,
             capture_output=True,
             text=True,
             timeout=timeout,
             cwd=PAPER_DIR.parent.parent,
+            env=env,
         )
     except subprocess.TimeoutExpired:
         record.update(ok=False, error=f"timeout after {timeout}s",
@@ -102,6 +113,9 @@ def main():
     ap.add_argument("--jobs", type=int, default=1,
                     help="Concurrent experiment subprocesses (use ~nproc; "
                          "each experiment is single-threaded on CPU)")
+    ap.add_argument("--device", choices=["cpu", "tpu"], default=None,
+                    help="Force the JAX platform for every run (a single "
+                         "TPU chip runs the matrix serially: --jobs 1)")
     args = ap.parse_args()
 
     RESULTS_DIR.mkdir(parents=True, exist_ok=True)
@@ -135,7 +149,9 @@ def main():
                 rel = str(cfg.relative_to(CONFIG_DIR))
                 print(f"[{i + 1}/{len(todo)}] {rel}", flush=True)
                 records = [r for r in records if r["config"] != rel]
-                records.append(run_one(cfg, out_path(rel), args.timeout))
+                records.append(
+                    run_one(cfg, out_path(rel), args.timeout, args.device)
+                )
                 results_file.write_text(json.dumps(records, indent=2))
         else:
             from concurrent.futures import ThreadPoolExecutor, as_completed
@@ -145,7 +161,7 @@ def main():
                     pool.submit(
                         run_one, cfg,
                         out_path(str(cfg.relative_to(CONFIG_DIR))),
-                        args.timeout,
+                        args.timeout, args.device,
                     ): str(cfg.relative_to(CONFIG_DIR))
                     for cfg in todo
                 }

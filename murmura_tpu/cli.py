@@ -34,8 +34,16 @@ def app():
               help="Rounds between checkpoints (with --checkpoint-dir)")
 @click.option("--resume/--no-resume", default=False,
               help="Resume from --checkpoint-dir if a checkpoint exists")
-def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every, resume):
+@click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
+              help="Force the JAX platform (reference: cli.py:37 device override)")
+def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
+        resume, device):
     """Run an experiment from a config file (reference: cli.py:34-60)."""
+    if device is not None:
+        # Must land before anything initializes the XLA backend.
+        import jax
+
+        jax.config.update("jax_platforms", device)
     config = load_config(config_path)
     if verbose is not None:
         config.experiment.verbose = verbose

@@ -9,7 +9,7 @@ strategy.  ``extra = "forbid"`` everywhere, like the reference
 
 from typing import Any, Dict, Literal, Optional
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import BaseModel, ConfigDict, Field, model_validator
 
 
 class _Strict(BaseModel):
@@ -92,6 +92,16 @@ class DMTTConfig(_Strict):
     lambda2: float = Field(default=0.3, description="Topology trust weight")
     lambda3: float = Field(default=0.2, description="Link reliability weight")
     lambda4: float = Field(default=0.1, description="Communication cost weight")
+    allow_static: bool = Field(
+        default=False,
+        description=(
+            "Permit DMTT without a mobility section: claim verification uses "
+            "the static topology as ground truth G^t.  Off by default so a "
+            "missing mobility block is an explicit choice, not a silent "
+            "fallback (murmura_tpu extension; the reference accepts it "
+            "silently — murmura/dmtt/node_process.py:247)"
+        ),
+    )
 
 
 class TrainingConfig(_Strict):
@@ -234,3 +244,13 @@ class Config(_Strict):
         default=None,
         description="DMTT protocol settings; requires mobility to also be set",
     )
+
+    @model_validator(mode="after")
+    def _dmtt_requires_mobility(self):
+        if self.dmtt is not None and self.mobility is None and not self.dmtt.allow_static:
+            raise ValueError(
+                "dmtt requires a mobility section (claim verification needs "
+                "the deterministic G^t); set dmtt.allow_static: true to "
+                "verify claims against the static topology instead"
+            )
+        return self

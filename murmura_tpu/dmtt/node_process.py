@@ -56,7 +56,7 @@ class DMTTNodeProcess(NodeProcess):
         super().__init__(*args, **kwargs)
         if self.config.dmtt is None:
             raise ValueError("DMTTNodeProcess requires config.dmtt")
-        self.dmtt = DMTTParams(**self.config.dmtt.model_dump())
+        self.dmtt = DMTTParams(**self.config.dmtt.model_dump(exclude={"allow_static"}))
         # Per-neighbor trust state (reference: state.py:42-47).
         self._c_hat: Dict[int, float] = {}
         self._alpha: Dict[int, float] = {}

@@ -78,3 +78,18 @@ def test_roundtrip(tmp_path):
         again = load_config(path)
         assert again.experiment.name == cfg.experiment.name
         assert again.topology.num_nodes == 4
+
+
+def test_dmtt_requires_mobility():
+    with pytest.raises(Exception, match="mobility"):
+        Config.model_validate({**BASIC, "dmtt": {"budget_B": 3}})
+    # Explicit opt-in verifies claims against the static topology instead.
+    cfg = Config.model_validate(
+        {**BASIC, "dmtt": {"budget_B": 3, "allow_static": True}}
+    )
+    assert cfg.dmtt.allow_static
+    # With mobility present the validator is satisfied.
+    cfg = Config.model_validate(
+        {**BASIC, "dmtt": {"budget_B": 3}, "mobility": {"comm_range": 30.0}}
+    )
+    assert cfg.mobility is not None

@@ -40,6 +40,10 @@ def _dmtt_cfg(tmp_path, num_nodes=4, rounds=2, mobility=True, attack=False):
     if mobility:
         cfg["mobility"] = {"area_size": 50.0, "comm_range": 30.0,
                             "max_speed": 5.0, "seed": 7}
+    else:
+        # dmtt without mobility must be opted into explicitly (schema
+        # validator); claims verify against the static topology.
+        cfg["dmtt"]["allow_static"] = True
     if attack:
         cfg["attack"] = {"enabled": True, "type": "topology_liar",
                           "percentage": 0.25, "params": {}}

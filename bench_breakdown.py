@@ -1,0 +1,176 @@
+"""Per-segment timing breakdown of the headline bench round.
+
+Answers "where do the milliseconds of one FL round go?" by compiling and
+timing nested subsets of the round program on the bench configuration
+(20-node k-regular(4), FEMNIST baseline CNN, Krum, 20% gaussian):
+
+    overhead   — round step with zero SGD steps and a pass-through
+                 aggregator: ravel/unravel, attack transform, dispatch.
+    local_sgd  — (pass-through step) - (overhead): the vmapped
+                 epochs x batches SGD scan.
+    krum       — (full krum step) - (pass-through step): pairwise distance
+                 matmuls + candidate-block selection.
+    eval       — the separately compiled eval sweep (paid only on
+                 eval_every rounds since round 3's eval split).
+
+Writes bench_breakdown.json (committed) and prints it.  Run on the real
+TPU (default env); the numbers anchor the MFU narrative in BENCH_r03.
+"""
+
+import json
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+
+
+def _timed_step(step, args, k1=5, k2=45):
+    """Marginal per-call device time of a round step, by chain length.
+
+    The axon tunnel has a large fixed sync latency (~65 ms per host fetch)
+    and its ``block_until_ready`` does not actually block, so per-call
+    timing is meaningless.  Instead: dispatch a chain of k steps feeding
+    params/agg_state forward, force one sync at the end, and report
+    (t(k2) - t(k1)) / (k2 - k1) — the fixed latency cancels.
+    """
+    params0, agg0, key, adj, comp, ridx, d = args
+
+    def run(k):
+        t0 = time.perf_counter()
+        p, a = params0, agg0
+        for _ in range(k):
+            p, a, _m = step(p, a, key, adj, comp, ridx, d)
+        jax.device_get(jax.tree_util.tree_leaves(p)[0])
+        return time.perf_counter() - t0
+
+    run(2)  # warmup (compile hit + stream spin-up)
+    t1 = run(k1)
+    t2 = run(k2)
+    return (t2 - t1) / (k2 - k1)
+
+
+def _timed_eval(ev, params, d, k1=5, k2=45):
+    """Marginal per-call device time of the eval sweep (same tunnel
+    latency cancellation as _timed_step; calls serialize on the device)."""
+
+    def run(k):
+        t0 = time.perf_counter()
+        m = None
+        for _ in range(k):
+            m = ev(params, d)
+        jax.device_get(jax.tree_util.tree_leaves(m)[0])
+        return time.perf_counter() - t0
+
+    run(2)
+    t1 = run(k1)
+    t2 = run(k2)
+    return (t2 - t1) / (k2 - k1)
+
+
+def build(algo: str, local_epochs: int):
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.aggregation.base import AggregatorDef
+    from murmura_tpu.config import Config
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.registry import build_federated_data
+    from murmura_tpu.models.registry import build_model
+    from murmura_tpu.utils.factories import build_attack
+
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": "breakdown", "seed": 7, "rounds": 10},
+            "topology": {"type": "k-regular", "num_nodes": 20, "k": 4},
+            "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+            "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
+                        "params": {"noise_std": 10.0}},
+            "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+            "data": {
+                "adapter": "synthetic",
+                "params": {"num_samples": 160 * 20, "input_shape": [28, 28, 1],
+                            "num_classes": 62},
+            },
+            "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
+            "backend": "tpu",
+            "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
+        }
+    )
+    data = build_federated_data(
+        cfg.data.adapter, cfg.data.params, num_nodes=20, seed=7
+    )
+    model = build_model(
+        cfg.model.factory, {"compute_dtype": "bfloat16"}
+    )
+    if algo == "passthrough":
+        agg = AggregatorDef(
+            name="passthrough",
+            aggregate=lambda own, bcast, adj, r, state, ctx: (own, state, {}),
+        )
+    else:
+        agg = build_aggregator(algo, {"num_compromised": 1, "max_candidates": 5})
+    attack = build_attack(cfg)
+    program = build_round_program(
+        model, agg, data,
+        local_epochs=local_epochs, batch_size=32, lr=0.05, total_rounds=10,
+        attack=attack, seed=7,
+    )
+    return program, attack
+
+
+def main():
+    from murmura_tpu.topology.generators import create_topology
+
+    results = {}
+    adj = None
+    for name, algo, epochs in (
+        ("overhead", "passthrough", 0),
+        ("passthrough_e1", "passthrough", 1),
+        ("krum_e1", "krum", 1),
+    ):
+        program, attack = build(algo, epochs)
+        if adj is None:
+            topo = create_topology("k-regular", num_nodes=20, k=4, seed=12345)
+            adj = jnp.asarray(topo.mask())
+            comp = jnp.asarray(attack.compromised.astype("float32"))
+        step = jax.jit(program.train_step)
+        d = {k: jnp.asarray(v) for k, v in program.data_arrays.items()}
+        args = (
+            program.init_params,
+            {k: jnp.asarray(v) for k, v in program.init_agg_state.items()},
+            jax.random.PRNGKey(0), adj, comp,
+            jnp.asarray(0.0, jnp.float32), d,
+        )
+        t0 = time.perf_counter()
+        results[name] = {"ms": round(1e3 * _timed_step(step, args), 3)}
+        results[name]["compile_and_time_s"] = round(time.perf_counter() - t0, 1)
+        if name == "krum_e1":
+            ev = jax.jit(program.eval_step)
+            results["eval"] = {
+                "ms": round(1e3 * _timed_eval(ev, program.init_params, d), 3)
+            }
+
+    seg = {
+        "overhead_ms": results["overhead"]["ms"],
+        "local_sgd_ms": round(
+            results["passthrough_e1"]["ms"] - results["overhead"]["ms"], 3
+        ),
+        "krum_exchange_ms": round(
+            results["krum_e1"]["ms"] - results["passthrough_e1"]["ms"], 3
+        ),
+        "eval_ms": results["eval"]["ms"],
+        "full_round_ms": results["krum_e1"]["ms"],
+    }
+    blob = {
+        "device_kind": jax.devices()[0].device_kind,
+        "backend": jax.default_backend(),
+        "segments": seg,
+        "raw": results,
+    }
+    Path(__file__).with_name("bench_breakdown.json").write_text(
+        json.dumps(blob, indent=2) + "\n"
+    )
+    print(json.dumps(blob))
+
+
+if __name__ == "__main__":
+    main()

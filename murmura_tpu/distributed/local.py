@@ -47,6 +47,8 @@ class LocalNode:
         x: np.ndarray,
         y: np.ndarray,
         *,
+        eval_x: Optional[np.ndarray] = None,
+        eval_y: Optional[np.ndarray] = None,
         max_neighbors: int,
         local_epochs: int = 1,
         batch_size: int = 64,
@@ -67,6 +69,11 @@ class LocalNode:
         self.x = jnp.asarray(x)
         self.y = jnp.asarray(y, jnp.int32)
         self.n_samples = n_samples
+        # Held-out evaluation arrays (round 3); default = training shard,
+        # matching the reference (murmura/core/network.py:289-294) and the
+        # simulation/tpu backends' eval_arrays fallback.
+        self._eval_x = self.x if eval_x is None else jnp.asarray(eval_x)
+        self._eval_y = self.y if eval_y is None else jnp.asarray(eval_y, jnp.int32)
         # reference batch rule (network.py:278-287)
         self.eff_batch = int(min(batch_size, max(2, n_samples)))
         self.steps = n_samples // self.eff_batch if n_samples > self.eff_batch else 1
@@ -158,17 +165,18 @@ class LocalNode:
     def _build_eval_fn(self):
         model = self.model
         evidential = self.evidential
+        ex, ey = self._eval_x, self._eval_y
 
         def evaluate(params):
-            out = model.apply(params, self.x, None, False)
-            mask = jnp.ones((self.x.shape[0],), jnp.float32)
+            out = model.apply(params, ex, None, False)
+            mask = jnp.ones((ex.shape[0],), jnp.float32)
             if evidential:
                 unc = uncertainty_metrics(out)
                 probs = unc["probs"]
                 nll = -jnp.log(
-                    jnp.take_along_axis(probs, self.y[:, None], axis=-1)[:, 0] + 1e-10
+                    jnp.take_along_axis(probs, ey[:, None], axis=-1)[:, 0] + 1e-10
                 )
-                acc = (jnp.argmax(out, -1) == self.y).mean()
+                acc = (jnp.argmax(out, -1) == ey).mean()
                 return {
                     "loss": nll.mean(),
                     "accuracy": acc,
@@ -176,7 +184,7 @@ class LocalNode:
                     "entropy": unc["entropy"].mean(),
                     "strength": unc["strength"].mean(),
                 }
-            loss, acc = masked_cross_entropy(out, self.y, mask)
+            loss, acc = masked_cross_entropy(out, ey, mask)
             return {"loss": loss, "accuracy": acc}
 
         return evaluate

@@ -112,7 +112,9 @@ class Monitor:
         if not rows:
             # Every node overran its training window: keep the round visible
             # with NaN metrics instead of silently producing an empty
-            # history (round-2 verdict weak #5).
+            # history (round-2 verdict weak #5).  Every list that has been
+            # recording (uncertainty, agg_*) gets a NaN too so history
+            # columns stay index-aligned with 'round'.
             self.history["round"].append(round_idx + 1)
             self.history["mean_accuracy"].append(float("nan"))
             self.history["std_accuracy"].append(float("nan"))
@@ -120,6 +122,10 @@ class Monitor:
             if self.compromised:
                 self.history["honest_accuracy"].append(float("nan"))
                 self.history["compromised_accuracy"].append(float("nan"))
+            for k, lst in self.history.items():
+                if (k.startswith("agg_") or k.startswith("mean_v")
+                        or k in ("mean_entropy", "mean_strength")) and lst:
+                    lst.append(float("nan"))
             return
         accs = np.array([m.get("accuracy", 0.0) for m in rows])
         losses = np.array([m.get("loss", 0.0) for m in rows])

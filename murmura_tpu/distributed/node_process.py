@@ -106,6 +106,12 @@ class NodeProcess:
             max_samples=cfg.training.max_samples,
         )
         x, y = data.get_client_data(self.node_id)
+        # Only pass separate eval arrays when a real test split exists;
+        # otherwise LocalNode aliases its training shard (no second device
+        # copy of the same data).
+        eval_x = eval_y = None
+        if data.x_test is not None:
+            eval_x, eval_y = data.get_client_eval_data(self.node_id)
 
         self.mobility = build_mobility(cfg)
         if self.mobility is None:
@@ -143,6 +149,8 @@ class NodeProcess:
             agg=agg,
             x=x,
             y=y,
+            eval_x=eval_x,
+            eval_y=eval_y,
             max_neighbors=max_deg,
             local_epochs=cfg.training.local_epochs,
             batch_size=cfg.training.batch_size,

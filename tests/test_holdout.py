@@ -164,6 +164,39 @@ class TestUciHarOfficialSplit:
         assert fa.x_test is None
 
 
+class TestLocalNodeHeldout:
+    def test_zmq_local_node_evaluates_on_heldout(self):
+        """Backend parity: the ZMQ LocalNode's eval sweep uses the held-out
+        arrays when the loader provides them."""
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.models.registry import build_model
+
+        model = build_model(
+            "mlp", {"input_dim": 4, "hidden_dims": [8], "num_classes": 2}
+        )
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(20, 4)).astype(np.float32)
+        y = (x[:, 0] > 0).astype(np.int32)
+        # Deliberately mislabeled held-out set: eval accuracy must reflect
+        # these labels, not the training shard's.
+        ex = x[:6]
+        ey = 1 - y[:6]
+        node = LocalNode(
+            0, model, build_aggregator("fedavg", {}), x, y,
+            eval_x=ex, eval_y=ey, max_neighbors=2, batch_size=4,
+        )
+        for r in range(30):
+            node.local_train(r)
+        train_acc = float(
+            (np.argmax(np.asarray(model.apply(node.params, x, None, False)), -1) == y).mean()
+        )
+        heldout_acc = node.evaluate()["accuracy"]
+        assert train_acc >= 0.75
+        # flipped labels: eval accuracy ~ (1 - accuracy on true labels)
+        assert heldout_acc < 0.5 < train_acc
+
+
 class TestRoundProgramUsesHeldout:
     def test_eval_arrays_wired_into_program(self):
         from murmura_tpu.core.rounds import build_round_program

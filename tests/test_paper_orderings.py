@@ -1,0 +1,41 @@
+"""The executed paper matrix must satisfy the reference paper's qualitative
+robustness orderings (SURVEY.md §6; reference
+experiments/paper/RESULTS_SUMMARY.md:7-38).
+
+Runs assert_orderings.py against the committed results.json — regenerate
+with experiments/paper/run_comprehensive.py after changing anything that
+moves accuracy (difficulty calibration, aggregation rules, holdout).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+PAPER = Path(__file__).parent.parent / "experiments" / "paper"
+RESULTS = PAPER / "results" / "results.json"
+
+
+@pytest.mark.slow
+def test_committed_matrix_satisfies_orderings():
+    if not RESULTS.exists():
+        pytest.skip("no committed results.json (run run_comprehensive.py)")
+    proc = subprocess.run(
+        [sys.executable, str(PAPER / "assert_orderings.py"),
+         "--results", str(RESULTS)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@pytest.mark.slow
+def test_committed_matrix_is_complete():
+    if not RESULTS.exists():
+        pytest.skip("no committed results.json (run run_comprehensive.py)")
+    records = json.loads(RESULTS.read_text())
+    ok = [r for r in records if r.get("ok")]
+    # The generator emits 261 configs (3 datasets x 6 algorithms x
+    # (1 + 3 + 6 + 4) + 9 ablation); the committed artifact must cover them.
+    assert len(ok) >= 252, f"only {len(ok)} experiments ok"

@@ -1,0 +1,95 @@
+"""Isolated local-SGD microbenchmark: what does the training segment cost
+without any FL machinery?
+
+Runs the same vmapped 20-node FEMNIST-CNN SGD step the round program
+executes (4 masked steps, batch 32/node, bf16 compute) as a standalone
+jitted scan, plus a plain 640-image fused-batch training step for
+comparison.  The gap between the two bounds what the per-node vmap
+formulation costs vs an ideal fused batch; the gap to bench_breakdown's
+local_sgd segment bounds what the FL data-indexing adds.
+
+Prints one JSON line; run on the real TPU (uses marginal chain timing —
+the axon tunnel's block_until_ready does not block).
+"""
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def marginal_ms(f, args, k1=5, k2=25):
+    def run(k):
+        t0 = time.perf_counter()
+        o = args[0]
+        for _ in range(k):
+            o = f(o, *args[1:])
+        jax.device_get(jax.tree_util.tree_leaves(o)[0].ravel()[0])
+        return time.perf_counter() - t0
+
+    run(2)
+    t1, t2 = run(k1), run(k2)
+    return 1e3 * (t2 - t1) / (k2 - k1)
+
+
+def main():
+    from murmura_tpu.models.cnn import make_femnist_cnn
+
+    n, b, steps = 20, 32, 4
+    model = make_femnist_cnn(num_classes=62, compute_dtype="bfloat16")
+    keys = jax.random.split(jax.random.PRNGKey(0), n)
+    params = jax.vmap(model.init)(keys)
+    x = jax.random.normal(jax.random.PRNGKey(1), (n, b * steps, 28, 28, 1))
+    y = jax.random.randint(jax.random.PRNGKey(2), (n, b * steps), 0, 62)
+
+    def node_loss(p, xb, yb):
+        logits = model.apply(p, xb, None, True)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(logp, yb[:, None], -1).mean()
+
+    grad = jax.grad(node_loss)
+
+    @jax.jit
+    def vmapped_steps(params, x, y):
+        def body(p, t):
+            xb = jax.lax.dynamic_slice_in_dim(x, t * b, b, 1)
+            yb = jax.lax.dynamic_slice_in_dim(y, t * b, b, 1)
+            g = jax.vmap(grad)(p, xb, yb)
+            return jax.tree_util.tree_map(lambda a, gg: a - 0.05 * gg, p, g), None
+
+        params, _ = jax.lax.scan(body, params, jnp.arange(steps))
+        return params
+
+    t_vmap = marginal_ms(vmapped_steps, (params, x, y))
+
+    # Ideal fused comparison: one model, batch n*b, same total images/step.
+    params1 = model.init(jax.random.PRNGKey(0))
+    xf = x.reshape(n * b * steps, 28, 28, 1)
+    yf = y.reshape(n * b * steps)
+
+    @jax.jit
+    def fused_steps(p, x, y):
+        def body(p, t):
+            xb = jax.lax.dynamic_slice_in_dim(x, t * n * b, n * b, 0)
+            yb = jax.lax.dynamic_slice_in_dim(y, t * n * b, n * b, 0)
+            g = grad(p, xb, yb)
+            return jax.tree_util.tree_map(lambda a, gg: a - 0.05 * gg, p, g), None
+
+        p, _ = jax.lax.scan(body, p, jnp.arange(steps))
+        return p
+
+    t_fused = marginal_ms(fused_steps, (params1, xf, yf))
+
+    print(json.dumps({
+        "device_kind": jax.devices()[0].device_kind,
+        "vmapped_20node_4step_ms": round(t_vmap, 2),
+        "fused_single_model_4step_ms": round(t_fused, 2),
+        "note": "vmapped = the round program's formulation (20 models, "
+                "batch 32 each); fused = one model at batch 640 (upper "
+                "bound on achievable MXU utilization for the same images)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

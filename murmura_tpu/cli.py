@@ -115,29 +115,44 @@ def run_node(config_path: Path, node_id, t_start, run_id, host):
 
 
 @app.command("list-components")
-def list_components():
-    """List available components (reference: cli.py:215-259)."""
+@click.argument("component_type", required=False, default=None)
+def list_components(component_type):
+    """List available components (reference: cli.py:215-259).
+
+    Optionally filter one category the way the reference does
+    (``murmura list-components aggregators``); with no argument the whole
+    table is shown.
+    """
     from murmura_tpu.aggregation import AGGREGATORS
     from murmura_tpu.attacks import ATTACKS
     from murmura_tpu.topology.generators import TOPOLOGY_TYPES
 
+    rows = {
+        "topologies": ", ".join(TOPOLOGY_TYPES),
+        "aggregators": ", ".join(sorted(AGGREGATORS)),
+        "attacks": ", ".join(sorted(ATTACKS)),
+        "backends": "simulation, tpu, distributed",
+        "models": (
+            "mlp, leaf.femnist[.tiny/.small/.baseline/.large/.xlarge], "
+            "leaf.celeba, leaf.shakespeare, wearables.{uci_har,pamap2,ppg_dalia}"
+        ),
+        "datasets": (
+            "synthetic, synthetic_sequences, leaf.{femnist,celeba,shakespeare}, "
+            "wearables.{uci_har,pamap2,ppg_dalia}"
+        ),
+    }
+    if component_type is not None:
+        if component_type not in rows:
+            console.print(f"[red]Unknown component type: {component_type}[/red]")
+            console.print("Available: " + ", ".join(rows))
+            raise SystemExit(1)
+        rows = {component_type: rows[component_type]}
+
     table = Table(title="murmura_tpu components")
     table.add_column("Category", style="cyan")
     table.add_column("Options")
-    table.add_row("topologies", ", ".join(TOPOLOGY_TYPES))
-    table.add_row("aggregators", ", ".join(sorted(AGGREGATORS)))
-    table.add_row("attacks", ", ".join(sorted(ATTACKS)))
-    table.add_row("backends", "simulation, tpu, distributed")
-    table.add_row(
-        "models",
-        "mlp, leaf.femnist[.tiny/.small/.baseline/.large/.xlarge], "
-        "leaf.celeba, leaf.shakespeare, wearables.{uci_har,pamap2,ppg_dalia}",
-    )
-    table.add_row(
-        "datasets",
-        "synthetic, synthetic_sequences, leaf.{femnist,celeba,shakespeare}, "
-        "wearables.{uci_har,pamap2,ppg_dalia}",
-    )
+    for k, v in rows.items():
+        table.add_row(k, v)
     console.print(table)
 
 

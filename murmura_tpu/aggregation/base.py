@@ -102,14 +102,30 @@ def pairwise_l2_distances(
     Centering leaves distances unchanged and shrinks the norms to the
     cluster scale.
     """
-    if b is None:
-        b = a
-    center = jnp.mean(a, axis=0, keepdims=True)
-    a = a - center
-    b = b - center
-    sq_a = jnp.sum(a * a, axis=-1)
-    sq_b = jnp.sum(b * b, axis=-1)
-    d2 = sq_a[:, None] + sq_b[None, :] - 2.0 * (a @ b.T)
+    same = b is None
+    in_dtype = a.dtype
+    a32 = a.astype(jnp.float32)
+    b32 = a32 if same else b.astype(jnp.float32)
+    center = jnp.mean(a32, axis=0, keepdims=True)
+    a32 = a32 - center
+    b32 = a32 if same else b32 - center
+    # Squared norms and the final combination accumulate in f32 regardless
+    # of input dtype: with bf16 params (tpu.param_dtype) a bf16 reduction
+    # would quantize the small post-centering distances the selection ranks
+    # on.  The Gram matmul itself keeps bf16 *inputs* with f32 accumulation
+    # (preferred_element_type) — the MXU-native mode — rather than f32
+    # operands, which would double the memory-bound matmul's HBM reads.
+    sq_a = jnp.sum(a32 * a32, axis=-1)
+    sq_b = sq_a if same else jnp.sum(b32 * b32, axis=-1)
+    if in_dtype == jnp.bfloat16:
+        da, db = a32.astype(in_dtype), b32.astype(in_dtype)
+    else:
+        da, db = a32, b32
+    d2 = (
+        sq_a[:, None]
+        + sq_b[None, :]
+        - 2.0 * jnp.dot(da, db.T, preferred_element_type=jnp.float32)
+    )
     return jnp.sqrt(jnp.maximum(d2, 0.0))
 
 

@@ -21,6 +21,16 @@ def make_gaussian_attack(
     compromised = select_compromised(num_nodes, attack_percentage, seed)
     comp_idx = np.flatnonzero(compromised)
 
+    # Static one-hot scatter matrix [N, C]: row expansion happens as a
+    # matmul instead of a scatter-add.  The scatter is both slower (~4x on
+    # a [20, 6.5M] state) and poisons XLA's layout choice for every [N, P]
+    # tensor downstream — scatter prefers a node-minor tiled layout that
+    # pads the node axis to 128 lanes (2x HBM at N=64, the 64-node OOM in
+    # bench_scaling's first run), and the layout copy propagates through
+    # the whole exchange.
+    scatter = np.zeros((num_nodes, len(comp_idx)), dtype=np.float32)
+    scatter[comp_idx, np.arange(len(comp_idx))] = 1.0
+
     def apply(flat, compromised_mask, key, round_idx):
         if flat.shape[0] == num_nodes and len(comp_idx):
             # Full-network view (the jitted round step): the compromised set
@@ -33,7 +43,9 @@ def make_gaussian_attack(
                 * noise_std
                 * compromised_mask[comp_idx, None]
             )
-            return flat.at[comp_idx].add(noise)
+            return flat + (
+                jnp.asarray(scatter, flat.dtype) @ noise
+            ).astype(flat.dtype)
         # Per-node views (ZMQ backend passes [1, P] with a ones mask).
         noise = jax.random.normal(key, flat.shape, flat.dtype) * noise_std
         return jnp.where(compromised_mask[:, None] > 0, flat + noise, flat)

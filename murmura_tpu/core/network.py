@@ -202,6 +202,15 @@ class Network:
                 self.save_checkpoint(checkpoint_dir)   # must be complete
                 last_saved = self.current_round
         self._drain_pending(pending, verbose)
+        if defer_metrics and rounds > 0:
+            # Quiesce: in deferred mode the only host syncs are the drained
+            # metrics, which cover rounds only up to the last eval — any
+            # later rounds are still in flight when the loop exits (and this
+            # environment's block_until_ready does not block).  Fetching one
+            # scalar that depends on the final params makes train() return
+            # only after every dispatched round has executed, so wall-clock
+            # timing around a deferred train() call is honest.
+            jax.device_get(jax.tree_util.tree_leaves(self.params)[0].ravel()[0])
         if checkpoint_dir and rounds > 0 and self.current_round != last_saved:
             self.save_checkpoint(checkpoint_dir)
 

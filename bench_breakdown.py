@@ -4,12 +4,16 @@ Answers "where do the milliseconds of one FL round go?" by compiling and
 timing nested subsets of the round program on the bench configuration
 (20-node k-regular(4), FEMNIST baseline CNN, Krum, 20% gaussian):
 
-    overhead   — round step with zero SGD steps and a pass-through
-                 aggregator: ravel/unravel, attack transform, dispatch.
-    local_sgd  — (pass-through step) - (overhead): the vmapped
-                 epochs x batches SGD scan.
-    krum       — (full krum step) - (pass-through step): pairwise distance
-                 matmuls + candidate-block selection.
+    overhead   — zero-SGD step with a pass-through aggregator returning
+                 ``own``: ravel/unravel + dispatch.  XLA dead-code
+                 eliminates the unused attack here — which is the point:
+                 it isolates the irreducible plumbing.
+    attack     — (zero-SGD pass-through returning ``bcast``) - (overhead):
+                 the [C, P] noise draw + one-hot matmul row expansion.
+    local_sgd  — (1-epoch pass-through-bcast step) - (attack step): the
+                 vmapped epochs x batches SGD scan.
+    krum       — (full krum step) - (1-epoch pass-through-bcast step):
+                 pairwise distance matmuls + candidate-block selection.
     eval       — the separately compiled eval sweep (paid only on
                  eval_every rounds since round 3's eval split).
 
@@ -106,6 +110,13 @@ def build(algo: str, local_epochs: int):
             name="passthrough",
             aggregate=lambda own, bcast, adj, r, state, ctx: (own, state, {}),
         )
+    elif algo == "passthrough_bcast":
+        # Returns the post-attack broadcast tensor so the attack transform
+        # cannot be dead-code eliminated (unlike ``passthrough``).
+        agg = AggregatorDef(
+            name="passthrough_bcast",
+            aggregate=lambda own, bcast, adj, r, state, ctx: (bcast, state, {}),
+        )
     else:
         agg = build_aggregator(algo, {"num_compromised": 1, "max_candidates": 5})
     attack = build_attack(cfg)
@@ -124,7 +135,8 @@ def main():
     adj = None
     for name, algo, epochs in (
         ("overhead", "passthrough", 0),
-        ("passthrough_e1", "passthrough", 1),
+        ("attack_e0", "passthrough_bcast", 0),
+        ("passthrough_e1", "passthrough_bcast", 1),
         ("krum_e1", "krum", 1),
     ):
         program, attack = build(algo, epochs)
@@ -151,10 +163,13 @@ def main():
 
     seg = {
         "overhead_ms": results["overhead"]["ms"],
-        "local_sgd_ms": round(
-            results["passthrough_e1"]["ms"] - results["overhead"]["ms"], 3
+        "attack_ms": round(
+            results["attack_e0"]["ms"] - results["overhead"]["ms"], 3
         ),
-        "krum_exchange_ms": round(
+        "local_sgd_ms": round(
+            results["passthrough_e1"]["ms"] - results["attack_e0"]["ms"], 3
+        ),
+        "krum_select_ms": round(
             results["krum_e1"]["ms"] - results["passthrough_e1"]["ms"], 3
         ),
         "eval_ms": results["eval"]["ms"],

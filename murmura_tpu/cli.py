@@ -88,6 +88,7 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
             verbose=config.experiment.verbose,
             checkpoint_dir=str(checkpoint_dir) if checkpoint_dir else None,
             checkpoint_every=checkpoint_every,
+            rounds_per_dispatch=config.tpu.rounds_per_dispatch,
         )
 
     _display_results(history)

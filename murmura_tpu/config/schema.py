@@ -206,6 +206,15 @@ class TPUConfig(_Strict):
     donate_state: bool = Field(
         default=True, description="Donate round-step input buffers to XLA"
     )
+    rounds_per_dispatch: int = Field(
+        default=1,
+        ge=1,
+        description=(
+            "Fuse this many FL rounds into one lax.scan program (device-"
+            "resident round loop; one dispatch + one metrics fetch per "
+            "chunk). Eval keeps the eval_every cadence via lax.cond."
+        ),
+    )
     profile_dir: Optional[str] = Field(
         default=None, description="If set, write a jax.profiler trace here"
     )

@@ -75,6 +75,10 @@ class Network:
         self.params = program.init_params
         self.agg_state = {k: jnp.asarray(v) for k, v in program.init_agg_state.items()}
         self._data = {k: jnp.asarray(v) for k, v in program.data_arrays.items()}
+        # Base key; round r always runs with fold_in(base, r), so the stream
+        # is a pure function of (seed, round) — identical across per-round
+        # and fused dispatch, any rounds_per_dispatch chunking, and
+        # checkpoint resume points.
         self._rng = jax.random.PRNGKey(seed)
 
         # History schema parity (reference: network.py:47-58)
@@ -90,6 +94,8 @@ class Network:
             "mean_strength": [],
         }
         self._last_stats: Dict[str, np.ndarray] = {}
+        self._donate = donate
+        self._fused_cache: Dict[Any, Any] = {}
         self.round_times: List[float] = []
         # Persistent round counter: schedules (BALANCE/trust tightening,
         # evidential-loss annealing) and the mobility G^t keep advancing
@@ -132,6 +138,7 @@ class Network:
         checkpoint_dir: Optional[str] = None,
         checkpoint_every: int = 0,
         defer_metrics: bool = False,
+        rounds_per_dispatch: int = 1,
     ) -> Dict[str, List[Any]]:
         """Run the FL rounds (reference: network.py:60-94).
 
@@ -149,19 +156,98 @@ class Network:
                 round loop so XLA queues rounds back-to-back (throughput
                 mode — history is identical, per-round ``round_times``
                 become dispatch times rather than wall round times).
+            rounds_per_dispatch: fuse this many rounds into one
+                ``lax.scan`` program (core.rounds.build_multi_round) — the
+                round loop lives on the device and history comes back as
+                stacked arrays per chunk.  Eval still runs only on the
+                ``eval_every`` cadence (``lax.cond`` inside the scan).
+                Checkpoints land on chunk boundaries.  1 = per-round
+                dispatch (default).
         """
         profile = self.profile_dir is not None
         if profile:
             jax.profiler.start_trace(self.profile_dir)
         try:
-            self._train_rounds(
-                rounds, verbose, eval_every, checkpoint_dir, checkpoint_every,
-                defer_metrics,
-            )
+            if rounds_per_dispatch > 1:
+                self._train_fused(
+                    rounds, verbose, eval_every, checkpoint_dir,
+                    checkpoint_every, rounds_per_dispatch,
+                )
+            else:
+                self._train_rounds(
+                    rounds, verbose, eval_every, checkpoint_dir,
+                    checkpoint_every, defer_metrics,
+                )
         finally:
             if profile:
                 jax.profiler.stop_trace()
         return self.history
+
+    def _fused_step(self, chunk: int, eval_every: int):
+        """Compiled fused multi-round program, cached per (chunk, cadence)."""
+        key = (chunk, eval_every)
+        if key not in self._fused_cache:
+            from murmura_tpu.core.rounds import build_multi_round
+
+            fn = build_multi_round(self.program, chunk, eval_every)
+            if self.backend == "tpu":
+                from murmura_tpu.parallel.mesh import shard_multi_round
+
+                self._fused_cache[key] = shard_multi_round(
+                    fn, self.program, self.mesh, donate=self._donate
+                )
+            else:
+                donate_argnums = (0, 1) if self._donate else ()
+                self._fused_cache[key] = jax.jit(
+                    fn, donate_argnums=donate_argnums
+                )
+        return self._fused_cache[key]
+
+    def _train_fused(
+        self, rounds, verbose, eval_every, checkpoint_dir, checkpoint_every,
+        chunk,
+    ) -> None:
+        comp = jnp.asarray(self.compromised)
+        done = 0
+        while done < rounds:
+            k = min(chunk, rounds - done)
+            step = self._fused_step(k, eval_every)
+            round0 = self.current_round
+            t0 = time.perf_counter()
+            adj_stack = jnp.asarray(
+                np.stack(
+                    [self._adjacency_for_round(round0 + i) for i in range(k)]
+                )
+            )
+            self.params, self.agg_state, rows = step(
+                self.params,
+                self.agg_state,
+                self._rng,
+                adj_stack,
+                comp,
+                jnp.asarray(round0, dtype=jnp.int32),
+                self._data,
+            )
+            rows = jax.device_get(rows)
+            self.current_round = round0 + k
+            self.round_times.append(time.perf_counter() - t0)
+            done += k
+            for i in range(k):
+                if rows["evaluated"][i]:
+                    self._record(
+                        round0 + i + 1,
+                        {
+                            m: v[i]
+                            for m, v in rows.items()
+                            if m != "evaluated"
+                        },
+                        verbose,
+                    )
+            crossed_cadence = checkpoint_every and (
+                self.current_round // checkpoint_every > round0 // checkpoint_every
+            )
+            if checkpoint_dir and (crossed_cadence or done >= rounds):
+                self.save_checkpoint(checkpoint_dir)
 
     def _train_rounds(
         self, rounds, verbose, eval_every, checkpoint_dir, checkpoint_every,
@@ -174,7 +260,7 @@ class Network:
             round_idx = self.current_round
             t0 = time.perf_counter()
             adj = jnp.asarray(self._adjacency_for_round(round_idx))
-            self._rng, step_key = jax.random.split(self._rng)
+            step_key = jax.random.fold_in(self._rng, round_idx)
             self.params, self.agg_state, agg_metrics = self._step(
                 self.params,
                 self.agg_state,
@@ -210,7 +296,13 @@ class Network:
             # scalar that depends on the final params makes train() return
             # only after every dispatched round has executed, so wall-clock
             # timing around a deferred train() call is honest.
-            jax.device_get(jax.tree_util.tree_leaves(self.params)[0].ravel()[0])
+            if jax.process_count() == 1:
+                jax.device_get(jax.tree_util.tree_leaves(self.params)[0].ravel()[0])
+            else:
+                # Multi-host: params are sharded across non-addressable
+                # devices, so a scalar fetch would raise; block on the
+                # sharded tree instead (real TPU runtimes do block here).
+                jax.block_until_ready(self.params)
         if checkpoint_dir and rounds > 0 and self.current_round != last_saved:
             self.save_checkpoint(checkpoint_dir)
 

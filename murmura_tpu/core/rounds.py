@@ -377,3 +377,60 @@ def build_round_program(
         model_dim=model_dim,
         evidential=evidential,
     )
+
+
+def build_multi_round(program: RoundProgram, chunk: int, eval_every: int):
+    """Fuse ``chunk`` FL rounds into one ``lax.scan`` program.
+
+    The SURVEY §7 end state: the round loop itself lives on the device and
+    metrics come back as device-resident history arrays after the scan —
+    one dispatch per ``chunk`` rounds instead of per round.  Evaluation runs
+    under ``lax.cond`` only on rounds where ``(round + 1) % eval_every == 0``
+    (cond executes a single branch, so skipped rounds pay zero eval FLOPs,
+    same as the separately-dispatched path).
+
+    Returns a function
+        (params, agg_state, base_key, adj_stack[chunk, N, N], compromised,
+         round0, data) -> (params', agg_state', rows)
+    where ``rows`` is a [chunk, ...] metrics pytree: per-round ``agg_*``
+    stats, eval metrics (zeros on unevaluated rounds), and an ``evaluated``
+    flag the orchestrator uses to select history rows.  ``adj_stack`` holds
+    the per-round adjacency (host-computed G^t for mobility; the static mask
+    tiled otherwise); per-round RNG is ``fold_in(base_key, round)`` so a
+    fused run consumes the same independent streams regardless of chunking.
+    """
+    as_struct = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
+    eval_struct = jax.eval_shape(
+        program.eval_step,
+        jax.tree_util.tree_map(as_struct, program.init_params),
+        {k: as_struct(v) for k, v in program.data_arrays.items()},
+    )
+
+    def multi_round(params, agg_state, base_key, adj_stack, compromised, round0, data):
+        def body(carry, xs):
+            params, agg_state = carry
+            i, adj = xs
+            r = round0 + i
+            key = jax.random.fold_in(base_key, r)
+            params, agg_state, m = program.train_step(
+                params, agg_state, key, adj, compromised,
+                r.astype(jnp.float32), data,
+            )
+            do_eval = (r + 1) % eval_every == 0
+            ev = jax.lax.cond(
+                do_eval,
+                lambda p: program.eval_step(p, data),
+                lambda p: jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), eval_struct
+                ),
+                params,
+            )
+            rows = {**m, **ev, "evaluated": do_eval}
+            return (params, agg_state), rows
+
+        (params, agg_state), rows = jax.lax.scan(
+            body, (params, agg_state), (jnp.arange(chunk), adj_stack)
+        )
+        return params, agg_state, rows
+
+    return multi_round

@@ -75,18 +75,16 @@ def _shard_leading_axis(tree: Any, node_sharding, replicated) -> Any:
     return jax.tree_util.tree_map(spec, tree)
 
 
-def shard_step(step, program, mesh: Mesh, donate: bool = True):
-    """Jit a RoundProgram step with the node axis sharded over ``mesh``.
+def _shard_round_fn(fn, program, mesh: Mesh, adj_sharding, donate: bool):
+    """Shared jit wrapper for round-shaped programs.
 
-    Args:
-        step: the traced round function (params, agg_state, key, adj,
-            compromised, round_idx, data) -> (params, agg_state, metrics).
-        program: RoundProgram (for example structures to derive shardings).
-        mesh: 1-D ``nodes`` mesh; program.num_nodes must be divisible by its
-            size.
-
-    Returns:
-        The compiled step with in/out shardings pinned.
+    Both the per-round step and the fused multi-round scan take
+    (params, agg_state, key, <adjacency>, compromised, round, data) and
+    return (params, agg_state, metrics); only the adjacency argument's
+    sharding differs.  Outputs: params/agg_state stay node-sharded; the
+    small per-node metrics arrays are replicated so the orchestrator's
+    device_get works when the mesh spans multiple processes (multi-host: a
+    node-sharded output would span non-addressable devices).
     """
     n_dev = mesh.devices.size
     if program.num_nodes % n_dev != 0:
@@ -103,22 +101,45 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
         params_s,  # params
         agg_s,  # agg_state
         repl,  # rng key
-        node_s,  # adj rows
+        adj_sharding,  # adjacency (per-round rows or stacked)
         node_s,  # compromised mask
-        repl,  # round_idx
+        repl,  # round index
         data_s,  # data dict
     )
-    # Outputs: params/agg_state stay node-sharded; the small per-node
-    # metrics arrays are replicated so the orchestrator's device_get works
-    # when the mesh spans multiple processes (multi-host: a node-sharded
-    # output would span non-addressable devices).
-    donate_argnums = (0, 1) if donate else ()
     return jax.jit(
-        step,
+        fn,
         in_shardings=in_shardings,
         out_shardings=(params_s, agg_s, repl),
-        donate_argnums=donate_argnums,
+        donate_argnums=(0, 1) if donate else (),
     )
+
+
+def shard_step(step, program, mesh: Mesh, donate: bool = True):
+    """Jit a RoundProgram step with the node axis sharded over ``mesh``.
+
+    Args:
+        step: the traced round function (params, agg_state, key, adj,
+            compromised, round_idx, data) -> (params, agg_state, metrics).
+        program: RoundProgram (for example structures to derive shardings).
+        mesh: 1-D ``nodes`` mesh; program.num_nodes must be divisible by its
+            size.
+
+    Returns:
+        The compiled step with in/out shardings pinned.
+    """
+    node_s, _ = make_shardings(mesh)
+    return _shard_round_fn(step, program, mesh, node_s, donate)
+
+
+def shard_multi_round(multi_round, program, mesh: Mesh, donate: bool = True):
+    """Jit a fused multi-round scan (core.rounds.build_multi_round) over
+    ``mesh`` with the same node-axis layout as :func:`shard_step`.
+
+    The per-round adjacency stack [chunk, N, N] is sharded on its *second*
+    axis (each device holds its nodes' rows for every round of the chunk).
+    """
+    adj_stack_s = NamedSharding(mesh, P(None, "nodes"))
+    return _shard_round_fn(multi_round, program, mesh, adj_stack_s, donate)
 
 
 def shard_eval_step(eval_step, program, mesh: Mesh):

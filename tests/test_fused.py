@@ -1,0 +1,101 @@
+"""Fused multi-round dispatch (core.rounds.build_multi_round): running K
+rounds inside one lax.scan must reproduce the per-round-dispatch history —
+same RNG streams (fold_in(base, round)), same eval cadence, same agg stats —
+on both backends, including chunk sizes that don't divide the round count
+and mobility graphs (per-round adjacency stacks)."""
+
+import numpy as np
+
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+
+
+def _cfg(backend: str = "simulation", **extra) -> Config:
+    raw = {
+        "experiment": {"name": "fused", "seed": 5, "rounds": 6},
+        "topology": {"type": "ring", "num_nodes": 8},
+        "aggregation": {"algorithm": "balance", "params": {"gamma": 2.0}},
+        "attack": {"enabled": True, "type": "gaussian", "percentage": 0.25,
+                    "params": {"noise_std": 5.0}},
+        "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 640, "input_dim": 24,
+                            "num_classes": 4}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 24, "hidden_dims": [32],
+                             "num_classes": 4}},
+        "backend": backend,
+        "tpu": {"compute_dtype": "float32"},
+    }
+    raw.update(extra)
+    return Config.model_validate(raw)
+
+
+def _assert_history_close(a, b, atol=1e-4):
+    assert a["round"] == b["round"]
+    for key in a:
+        if key == "round" or not a[key]:
+            continue
+        np.testing.assert_allclose(
+            a[key], b[key], rtol=1e-3, atol=atol, err_msg=f"history[{key}]"
+        )
+
+
+def test_fused_matches_per_round_dispatch():
+    base = build_network_from_config(_cfg()).train(rounds=6, eval_every=2)
+    fused = build_network_from_config(_cfg()).train(
+        rounds=6, eval_every=2, rounds_per_dispatch=3
+    )
+    assert base["round"] == [2, 4, 6]
+    _assert_history_close(base, fused)
+
+
+def test_fused_ragged_chunk_and_cadence():
+    # chunk 4 over 6 rounds (tail chunk of 2), eval cadence not aligned
+    # to the chunk boundary.
+    base = build_network_from_config(_cfg()).train(rounds=6, eval_every=3)
+    fused = build_network_from_config(_cfg()).train(
+        rounds=6, eval_every=3, rounds_per_dispatch=4
+    )
+    assert base["round"] == [3, 6]
+    _assert_history_close(base, fused)
+
+
+def test_fused_on_sharded_mesh():
+    base = build_network_from_config(_cfg("tpu")).train(rounds=4, eval_every=2)
+    fused = build_network_from_config(_cfg("tpu")).train(
+        rounds=4, eval_every=2, rounds_per_dispatch=2
+    )
+    _assert_history_close(base, fused)
+
+
+def test_fused_checkpoints_on_cadence_crossings(tmp_path):
+    # chunk=4 with checkpoint_every=6: chunks end at rounds 4, 8 — neither
+    # divisible by 6 — but the 4->8 chunk crosses the round-6 cadence
+    # boundary and must save.
+    net = build_network_from_config(_cfg())
+    saves = []
+    net.save_checkpoint = lambda d: saves.append(net.current_round)
+    net.train(rounds=8, eval_every=4, rounds_per_dispatch=4,
+              checkpoint_dir=str(tmp_path), checkpoint_every=6)
+    assert saves == [8]  # crossed at the round-8 chunk end (6 in [5, 8])
+
+    net2 = build_network_from_config(_cfg())
+    saves2 = []
+    net2.save_checkpoint = lambda d: saves2.append(net2.current_round)
+    net2.train(rounds=12, eval_every=4, rounds_per_dispatch=4,
+               checkpoint_dir=str(tmp_path), checkpoint_every=6)
+    assert saves2 == [8, 12]  # crossings at 6 (in 5-8) and 12 (final)
+
+
+def test_fused_with_mobility_adjacency_stack():
+    extra = {
+        "mobility": {"area_size": 50.0, "comm_range": 30.0, "max_speed": 5.0,
+                      "seed": 3},
+        "aggregation": {"algorithm": "fedavg", "params": {}},
+    }
+    base = build_network_from_config(_cfg(**extra)).train(rounds=4, eval_every=2)
+    fused = build_network_from_config(_cfg(**extra)).train(
+        rounds=4, eval_every=2, rounds_per_dispatch=4
+    )
+    _assert_history_close(base, fused)

@@ -4,9 +4,10 @@ Scenario (BASELINE.json config #2): Krum aggregation, 20-node k-regular(4)
 topology, 20% Gaussian-Byzantine nodes, FEMNIST baseline CNN (~6.5M params),
 one local epoch per round.  Data is FEMNIST-shaped synthetic (28x28x1, 62
 classes; zero-egress environment).  The whole round — local SGD, attack,
-adjacency-masked exchange, Krum selection over the gathered [N, P] tensor,
-eval — is one jitted program on the default device (the real TPU chip under
-the driver).
+adjacency-masked exchange, Krum selection over the gathered [N, P] tensor —
+is one jitted program on the default device (the real TPU chip under the
+driver), and the timed block fuses all its rounds into a single lax.scan
+dispatch (rounds_per_dispatch) with eval on the final round only.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} plus
 extras (backend, probe log, compile time, per-round times, flops, MFU).
@@ -141,25 +142,24 @@ def main():
 
     network = build_network(on_cpu)
 
-    # First round = compile + execute; two more to reach steady state.
-    t0 = time.perf_counter()
-    network.train(rounds=1)
-    compile_s = time.perf_counter() - t0
-    network.train(rounds=2)
-
     timed_rounds = 5 if on_cpu else 20
-    t0 = time.perf_counter()
-    # defer_metrics: no host sync inside the loop — XLA queues the rounds
-    # back-to-back; history is recorded (identically) after the last round.
-    # eval_every=timed_rounds: the eval sweep is a separately compiled
-    # program that runs only on recorded rounds, so the timed block pays
-    # for it once (round 2 fix: the fused step used to evaluate every
-    # round regardless of cadence).
-    network.train(rounds=timed_rounds, defer_metrics=True,
-                  eval_every=timed_rounds)
-    elapsed = time.perf_counter() - t0
+
+    # The timed block is ONE dispatch: all rounds fused into a lax.scan
+    # program (tpu.rounds_per_dispatch) with the round loop device-resident
+    # and eval running (under lax.cond) only on the last round of the
+    # chunk.  First call compiles; the second absorbs the steady-state
+    # input-layout recompile (the step specialized to the layouts of its
+    # own outputs); the third is the measurement.
+    def block():
+        t0 = time.perf_counter()
+        network.train(rounds=timed_rounds, eval_every=timed_rounds,
+                      rounds_per_dispatch=timed_rounds)
+        return time.perf_counter() - t0
+
+    compile_s = block()
+    warmup_s = block()
+    elapsed = block()
     rounds_per_sec = timed_rounds / elapsed
-    round_times = network.round_times[-timed_rounds:]
 
     # MFU: XLA's own flop count for the per-round train program (local SGD
     # + attack + exchange + Krum) vs peak chip flops.  Eval is a separate
@@ -185,12 +185,12 @@ def main():
                 "device_kind": device_kind,
                 "probe_log": probe_log,
                 "compile_s": round(compile_s, 2),
+                "steady_warmup_s": round(warmup_s, 2),
                 "round_ms": {
-                    # wall mean over the deferred-metrics timed block; the
-                    # per-round entries are dispatch times in that mode.
+                    # wall mean over the timed single-dispatch fused block
+                    # (train() returns only after the chunk's metrics are
+                    # fetched, so the wall clock covers every round).
                     "mean": round(1e3 * elapsed / timed_rounds, 2),
-                    "dispatch_min": round(1e3 * min(round_times), 2),
-                    "dispatch_max": round(1e3 * max(round_times), 2),
                 },
                 "flops_per_round": flops,
                 "mfu": mfu,

@@ -137,11 +137,20 @@ def circulant_neighbor_distances(
     circulant graphs (tpu.exchange: ppermute). Each roll lowers to
     boundary-slice collective-permutes on a sharded node axis, and the
     direct elementwise norm avoids the Gram-identity cancellation the dense
-    path has to center against."""
+    path has to center against.  The squared-diff reduction runs in f32
+    regardless of input dtype (XLA fuses the upcast into the reduce, no
+    extra HBM pass): a bf16 accumulation over millions of terms would
+    quantize the small distances the Byzantine selections rank on, same
+    hazard :func:`pairwise_l2_distances` guards against."""
     return jnp.stack(
         [
             jnp.sqrt(
-                jnp.sum((own - jnp.roll(bcast, -o, axis=0)) ** 2, axis=-1)
+                jnp.sum(
+                    jnp.square(
+                        (own - jnp.roll(bcast, -o, axis=0)).astype(jnp.float32)
+                    ),
+                    axis=-1,
+                )
             )
             for o in offsets
         ]

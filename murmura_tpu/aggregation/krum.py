@@ -17,7 +17,13 @@ m = max_candidates instead of the O(N³) that sorting full per-node [N, N]
 copies under vmap materializes (round-2 verdict weak #4).  ``max_candidates``
 is injected by the factories as max-degree+1 for static topologies; the
 default m = N is the dense fallback for dynamic graphs (mobility/DMTT).
+
+On circulant graphs (tpu.exchange: ppermute) the dense Gram disappears
+entirely: see ``aggregate_circulant`` below — O(k·N·P) delta vectors, the
+O(degree) exchange the other five rules already have.
 """
+
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -25,15 +31,90 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    circulant_masked_mean,
+    circulant_neighbor_distances,
     pairwise_l2_distances,
 )
 
 
 def make_krum(
-    num_compromised: int = 0, max_candidates: int = None, **_params
+    num_compromised: int = 0,
+    max_candidates: int = None,
+    exchange_offsets: Optional[Sequence[int]] = None,
+    **_params,
 ) -> AggregatorDef:
     c = int(num_compromised)
     mc = None if max_candidates is None else int(max_candidates)
+    offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+
+    def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
+        """O(degree) Krum for circulant graphs (tpu.exchange: ppermute).
+
+        Every candidate-pair distance on a circulant graph is one entry of
+        a shared "delta vector": for candidates at offsets o_a, o_b from
+        node i, ``||bcast[i+o_a] - bcast[i+o_b]||`` equals
+        ``B_d[i + min(o_a, o_b)]`` with ``B_d[j] = ||bcast_j - bcast_{j+d}||``
+        and d = |o_b - o_a|.  So the whole selection needs only
+        |deltas| + k rolled elementwise norms — O(k·N·P) work and O(k·N)
+        memory versus the dense path's O(N²·P) Gram matmul and [N, N]
+        matrices — and each roll lowers to boundary collective-permutes on
+        a sharded node axis.
+        """
+        n = own.shape[0]
+        k = len(offsets)
+        m = k + 1  # self + full circulant degree at every node
+        if not c < (m - 2) / 2:
+            # The Krum constraint (krum.py:49-52) fails identically at
+            # every node of a degree-regular graph: all keep their own
+            # state.  Static, so no traced fallback is needed.
+            zeros = jnp.zeros((n,), jnp.float32)
+            return own, state, {
+                "selected_index": jnp.arange(n),
+                "krum_score": zeros,
+                "selected_own": zeros + 1.0,
+            }
+
+        own_d = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
+        deltas = sorted(
+            {abs(o2 - o1) for o1 in offsets for o2 in offsets if o1 != o2}
+        )
+        bcast_d = circulant_neighbor_distances(bcast, bcast, deltas)  # [D, N]
+        didx = {d: i for i, d in enumerate(deltas)}
+
+        # [m, m, N] candidate-pair distances per node, assembled from the
+        # delta vectors with cheap [N] rolls (m is a small static constant).
+        rows = []
+        for a in range(m):
+            cols = []
+            for b in range(m):
+                if a == b:
+                    cols.append(jnp.full((n,), jnp.inf, own_d.dtype))
+                elif a == 0 or b == 0:
+                    cols.append(own_d[max(a, b) - 1])
+                else:
+                    o_a, o_b = offsets[a - 1], offsets[b - 1]
+                    v = bcast_d[didx[abs(o_b - o_a)]]
+                    cols.append(jnp.roll(v, -min(o_a, o_b)))
+            rows.append(jnp.stack(cols))
+        pair = jnp.stack(rows)  # [m, m, N]
+
+        num_closest = max(1, m - c - 2)
+        ranked = jnp.sort(pair, axis=1)
+        scores = ranked[:, :num_closest, :].sum(axis=1)  # [m, N]
+        w = jnp.argmin(scores, axis=0)  # [N] candidate position
+        best = jnp.min(scores, axis=0)
+
+        accept_k = (w[None, :] == jnp.arange(1, m)[:, None]).astype(own.dtype)
+        neighbor_sel = circulant_masked_mean(bcast, accept_k, offsets)
+        selected_own = w == 0
+        new_flat = jnp.where(selected_own[:, None], own, neighbor_sel)
+        offs = jnp.asarray([0] + offsets)
+        stats = {
+            "selected_index": (jnp.arange(n) + offs[w]) % n,
+            "krum_score": best,
+            "selected_own": selected_own.astype(jnp.float32),
+        }
+        return new_flat, state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
@@ -85,4 +166,7 @@ def make_krum(
         }
         return new_flat, state, stats
 
-    return AggregatorDef(name="krum", aggregate=aggregate)
+    return AggregatorDef(
+        name="krum",
+        aggregate=aggregate if offsets is None else aggregate_circulant,
+    )

@@ -141,13 +141,8 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
 
     if config.backend == "tpu" and config.tpu.exchange == "ppermute":
         # O(degree) neighbor exchange via circular shifts (circulant paths
-        # in fedavg/balance/sketchguard/ubar/evidential_trust).
-        if config.aggregation.algorithm == "krum":
-            raise ValueError(
-                "tpu.exchange: ppermute does not support krum (its selection "
-                "needs the global pairwise-distance matrix); use "
-                "exchange: allgather"
-            )
+        # in all six rules; krum assembles its candidate-pair distances
+        # from rolled delta vectors instead of the global Gram matrix).
         if mobility is not None or config.dmtt is not None:
             raise ValueError(
                 "tpu.exchange: ppermute requires a static circulant topology "

@@ -133,6 +133,54 @@ class TestKrum:
             )
             np.testing.assert_allclose(np.asarray(new_d), np.asarray(new_c), atol=1e-6)
 
+    def test_circulant_path_matches_dense(self):
+        """The O(degree) delta-vector path (exchange_offsets, tpu.exchange:
+        ppermute) must select exactly what the dense Gram path selects on
+        the equivalent circulant adjacency."""
+        rng = np.random.default_rng(7)
+        n = 12
+        own = rng.normal(size=(n, 16)).astype(np.float32)
+        bcast = own + rng.normal(size=(n, 16)).astype(np.float32) * 0.1
+        bcast[3] += 40.0
+        bcast[8] -= 40.0
+        # [1, 2, 10, 11] is the production form: circulant_offsets() returns
+        # positive residues (np.flatnonzero of row 0), not symmetric +/-.
+        for offsets in (
+            [-1, 1],
+            [-2, -1, 1, 2],
+            [-3, -2, -1, 1, 2, 3],
+            [1, 2, 10, 11],
+        ):
+            adj = np.zeros((n, n), dtype=np.float32)
+            for i in range(n):
+                for o in offsets:
+                    adj[i, (i + o) % n] = 1.0
+            dense = build_aggregator("krum", {"num_compromised": 1})
+            circ = build_aggregator(
+                "krum",
+                {"num_compromised": 1, "exchange_offsets": offsets},
+            )
+            new_d, _, st_d = _run(dense, own, jnp.asarray(adj), bcast=bcast)
+            new_c, _, st_c = _run(circ, own, jnp.asarray(adj), bcast=bcast)
+            if len(offsets) == 2:
+                # m=3, c=1 fails the Krum constraint: both paths keep own.
+                np.testing.assert_allclose(np.asarray(new_c), own, atol=1e-6)
+                np.testing.assert_allclose(np.asarray(new_d), own, atol=1e-6)
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(st_d["selected_index"]),
+                np.asarray(st_c["selected_index"]),
+            )
+            np.testing.assert_allclose(
+                np.asarray(new_d), np.asarray(new_c), atol=1e-5
+            )
+            np.testing.assert_allclose(
+                np.asarray(st_d["krum_score"]),
+                np.asarray(st_c["krum_score"]),
+                rtol=1e-4,
+                atol=1e-4,
+            )
+
 
 class TestBalance:
     def test_threshold_filters_outlier(self):

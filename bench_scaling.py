@@ -2,11 +2,12 @@
 
 The north-star scaling axis (BASELINE.json; SURVEY.md §7 memory-at-scale
 note): rounds/sec and peak device memory for
-``nodes in {8, 64, 256} x {krum/allgather, balance/ppermute}``, all nodes
-resident on a single chip.  krum/allgather is the O(N) dense-exchange
-worst case (every node sees the full [N, P] tensor and a global N x N
-distance matrix); balance/ppermute is the O(degree) circulant path that is
-the intended large-N configuration.
+``nodes in {8, 64, 256} x {krum/allgather, balance/ppermute}`` plus
+1024-node points and krum/ppermute (circulant delta-vector Krum), all
+nodes resident on a single chip.  krum/allgather is the O(N)
+dense-exchange worst case (every node sees the full [N, P] tensor and a
+global N x N distance matrix); the ppermute points are the O(degree)
+circulant path that is the intended large-N configuration.
 
 Each point runs in its OWN subprocess: peak memory stats start clean, and
 an OOM kills the point, not the harness.  On TPU the flagship ~6.5M-param
@@ -37,6 +38,11 @@ POINTS = [
     {"nodes": 1024, "algo": "krum", "exchange": "allgather",
      "variant": "small"},
     {"nodes": 1024, "algo": "balance", "exchange": "ppermute",
+     "variant": "small"},
+    # Circulant Krum (delta-vector distances): the O(degree) large-N
+    # configuration for the flagship rule — no [N, N] matrices, no Gram.
+    {"nodes": 256, "algo": "krum", "exchange": "ppermute"},
+    {"nodes": 1024, "algo": "krum", "exchange": "ppermute",
      "variant": "small"},
 ]
 
@@ -93,29 +99,23 @@ def run_point(
     )
     network = build_network_from_config(cfg)
 
-    t0 = time.perf_counter()
-    network.train(rounds=1)  # compile + first round
-    compile_s = time.perf_counter() - t0
-
-    # Steady-state warmup: the first step of a follow-on train() call hits
-    # one more compile — the step specialized to the layouts of its own
-    # outputs (params now live in XLA-chosen layouts, not the row-major
-    # host arrays the first compile saw).  bench.py's warmup block absorbs
-    # this; without it the timed block pays a multi-second compile and the
-    # scaling numbers are meaningless.
-    t0 = time.perf_counter()
-    network.train(rounds=2, defer_metrics=True, eval_every=2)
-    warmup_s = time.perf_counter() - t0
-
+    # Same convention as bench.py: every block is ONE fused lax.scan
+    # dispatch (eval on the block's last round under lax.cond).  Block 1
+    # compiles, block 2 absorbs the steady-state input-layout recompile
+    # (the program specialized to the layouts of its own outputs), block 3
+    # is the measurement; train() returns only after the chunk's metrics
+    # are fetched, so the wall clock covers every round.
     timed = 2 if on_cpu else 10
-    t0 = time.perf_counter()
-    # Same throughput conventions as bench.py: deferred metrics (no host
-    # sync in the loop), exactly one eval inside the timed block
-    # (eval_every is matched against the cumulative round counter), and
-    # train() quiescing the device before returning so the wall clock
-    # covers every dispatched round.
-    network.train(rounds=timed, defer_metrics=True, eval_every=timed)
-    rounds_per_sec = timed / (time.perf_counter() - t0)
+
+    def block():
+        t0 = time.perf_counter()
+        network.train(rounds=timed, eval_every=timed,
+                      rounds_per_dispatch=timed)
+        return time.perf_counter() - t0
+
+    compile_s = block()
+    warmup_s = block()
+    rounds_per_sec = timed / block()
 
     mem = {}
     stats = jax.local_devices()[0].memory_stats() or {}

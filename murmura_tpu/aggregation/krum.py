@@ -63,16 +63,12 @@ def make_krum(
         n = own.shape[0]
         k = len(offsets)
         m = k + 1  # self + full circulant degree at every node
-        if not c < (m - 2) / 2:
-            # The Krum constraint (krum.py:49-52) fails identically at
-            # every node of a degree-regular graph: all keep their own
-            # state.  Static, so no traced fallback is needed.
-            zeros = jnp.zeros((n,), jnp.float32)
-            return own, state, {
-                "selected_index": jnp.arange(n),
-                "krum_score": zeros,
-                "selected_own": zeros + 1.0,
-            }
+        # The Krum constraint (krum.py:49-52) holds or fails identically at
+        # every node of a degree-regular graph — a static Python bool, not
+        # a traced fallback.  Scores are computed either way so the
+        # krum_score stat matches the dense path's (which reports the
+        # argmin score even when the constraint forces the own state).
+        ok = c < (m - 2) / 2
 
         own_d = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
         deltas = sorted(
@@ -104,6 +100,8 @@ def make_krum(
         w = jnp.argmin(scores, axis=0)  # [N] candidate position
         best = jnp.min(scores, axis=0)
 
+        if not ok:
+            w = jnp.zeros((n,), w.dtype)  # every node keeps its own state
         accept_k = (w[None, :] == jnp.arange(1, m)[:, None]).astype(own.dtype)
         neighbor_sel = circulant_masked_mean(bcast, accept_k, offsets)
         selected_own = w == 0

@@ -163,9 +163,15 @@ class TestKrum:
             new_d, _, st_d = _run(dense, own, jnp.asarray(adj), bcast=bcast)
             new_c, _, st_c = _run(circ, own, jnp.asarray(adj), bcast=bcast)
             if len(offsets) == 2:
-                # m=3, c=1 fails the Krum constraint: both paths keep own.
+                # m=3, c=1 fails the Krum constraint: both paths keep own
+                # but still report the computed argmin score (krum.py:73-75).
                 np.testing.assert_allclose(np.asarray(new_c), own, atol=1e-6)
                 np.testing.assert_allclose(np.asarray(new_d), own, atol=1e-6)
+                np.testing.assert_allclose(
+                    np.asarray(st_d["krum_score"]),
+                    np.asarray(st_c["krum_score"]),
+                    rtol=1e-4, atol=1e-4,
+                )
                 continue
             np.testing.assert_array_equal(
                 np.asarray(st_d["selected_index"]),

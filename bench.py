@@ -126,6 +126,9 @@ def build_network(on_cpu: bool, num_nodes: int = 20):
             "tpu": {
                 "num_devices": 1,
                 "compute_dtype": "float32" if on_cpu else "bfloat16",
+                # Persistent compile cache: repeat bench invocations (and
+                # the driver's periodic runs) skip identical XLA compiles.
+                "compilation_cache_dir": "/tmp/murmura_jax_cache",
             },
         }
     )

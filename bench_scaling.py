@@ -94,6 +94,9 @@ def run_point(
                 "compute_dtype": "float32" if on_cpu else "bfloat16",
                 "param_dtype": "float32" if on_cpu else "bfloat16",
                 "exchange": exchange,
+                # Shared across the per-point subprocesses: repeated runs
+                # of the sweep skip identical XLA compiles.
+                "compilation_cache_dir": "/tmp/murmura_jax_cache",
             },
         }
     )

@@ -73,8 +73,10 @@ class NodeProcess:
     def run(self) -> None:
         """Entry point inside the child process (reference: node_process.py:111-124)."""
         _force_cpu_jax()
+        from murmura_tpu.utils.factories import apply_compilation_cache
         from murmura_tpu.utils.seed import set_seed
 
+        apply_compilation_cache(self.config)
         # per-node seeding (node_process.py:113)
         set_seed(self.config.experiment.seed + self.node_id)
         self._build_node()

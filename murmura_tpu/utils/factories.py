@@ -83,6 +83,22 @@ def build_mobility(config: Config) -> Optional[MobilityModel]:
     )
 
 
+def apply_compilation_cache(config: Config) -> None:
+    """Enable JAX's persistent compilation cache when configured.
+
+    Shared by the in-process backends (via build_network_from_config) and
+    the ZMQ worker processes (NodeProcess.run), so ``murmura run`` pays an
+    identical round program's XLA compile once per machine, not once per
+    run per process.
+    """
+    if config.tpu.compilation_cache_dir:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", config.tpu.compilation_cache_dir
+        )
+
+
 def build_network_from_config(config: Config, mesh=None) -> Network:
     """Full wiring: data + model + aggregator + attack -> Network."""
     if config.backend == "tpu" and config.tpu.multihost and mesh is None:
@@ -96,6 +112,8 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
             num_processes=config.tpu.num_processes,
             process_id=config.tpu.process_id,
         )
+
+    apply_compilation_cache(config)
 
     n = config.topology.num_nodes
     seed = config.experiment.seed

@@ -172,6 +172,10 @@ class TestFullStack:
         sim_cfg = cfg.model_copy(update={"backend": "simulation"})
         sim_history = build_network_from_config(sim_cfg).train(rounds=2)
         populated = lambda h: {k for k, v in h.items() if len(v) > 0}
-        assert populated(history) == populated(sim_history), (
+        # skipped_nodes is distributed-only degradation telemetry: it
+        # appears whenever a loaded suite machine makes a worker overrun
+        # its round window (wall-clock rounds), which is legitimate
+        # behavior, not a schema divergence.
+        assert populated(history) - {"skipped_nodes"} == populated(sim_history), (
             populated(history) ^ populated(sim_history)
         )

@@ -15,6 +15,10 @@ from murmura_tpu.aggregation.balance import make_balance
 from murmura_tpu.aggregation.sketchguard import make_sketchguard
 from murmura_tpu.aggregation.ubar import make_ubar
 from murmura_tpu.aggregation.evidential_trust import make_evidential_trust
+from murmura_tpu.aggregation.robust_stats import (
+    make_coordinate_median,
+    make_trimmed_mean,
+)
 
 AGGREGATORS = {
     "fedavg": make_fedavg,
@@ -23,6 +27,9 @@ AGGREGATORS = {
     "sketchguard": make_sketchguard,
     "ubar": make_ubar,
     "evidential_trust": make_evidential_trust,
+    # Beyond reference parity: the classic coordinate-wise robust rules.
+    "median": make_coordinate_median,
+    "trimmed_mean": make_trimmed_mean,
 }
 
 
@@ -64,6 +71,8 @@ __all__ = [
     "make_sketchguard",
     "make_ubar",
     "make_evidential_trust",
+    "make_coordinate_median",
+    "make_trimmed_mean",
     "pairwise_l2_distances",
     "masked_neighbor_mean",
 ]

@@ -173,6 +173,23 @@ def circulant_masked_mean(
     return acc / jnp.maximum(cnt, 1e-12)[:, None]
 
 
+def candidate_indices(adj: jnp.ndarray, m_cap: int):
+    """Per-node candidate ordering shared by the candidate-block rules.
+
+    Rank self first (2), neighbors next (1), non-candidates last; argsort
+    is stable so neighbor indices come out ascending and truncation at
+    ``m_cap`` is deterministic (krum.py candidate blocks; robust_stats.py).
+
+    Returns:
+        (cand_idx [N, m], valid [N, m] bool).
+    """
+    n = adj.shape[0]
+    rank = adj + 2.0 * jnp.eye(n, dtype=adj.dtype)
+    cand_idx = jnp.argsort(-rank, axis=1)[:, :m_cap]
+    valid = jnp.take_along_axis(rank, cand_idx, axis=1) > 0.0
+    return cand_idx, valid
+
+
 def masked_neighbor_mean(bcast: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
     """Weighted neighbor mean per node: (W @ bcast) / row-sum, safe on empty rows."""
     totals = weights.sum(axis=1, keepdims=True)

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    candidate_indices,
     circulant_masked_mean,
     circulant_neighbor_distances,
     pairwise_l2_distances,
@@ -120,12 +121,7 @@ def make_krum(
         d_bcast = pairwise_l2_distances(bcast)
         d_own = pairwise_l2_distances(own, bcast)  # [i, j] = ||own_i - bcast_j||
 
-        # Candidate order per node: self first (rank 2), neighbors (rank 1),
-        # non-candidates last.  argsort is stable, so neighbor indices come
-        # out ascending and truncation at m_cap is deterministic.
-        rank = adj + 2.0 * jnp.eye(n, dtype=adj.dtype)
-        cand_idx = jnp.argsort(-rank, axis=1)[:, :m_cap]  # [N, m]
-        valid = jnp.take_along_axis(rank, cand_idx, axis=1) > 0.0  # [N, m]
+        cand_idx, valid = candidate_indices(adj, m_cap)  # [N, m] each
         pair_eye = jnp.eye(m_cap, dtype=bool)
 
         def select_for_node(node_idx, ci, vi):

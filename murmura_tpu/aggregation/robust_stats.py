@@ -1,0 +1,97 @@
+"""Coordinate-wise robust statistics: median and trimmed mean.
+
+No reference counterpart (murmura ships exactly six rules); these are the
+two classic Byzantine-robust baselines from the distributed-SGD literature
+(coordinate-wise median / trimmed mean, Yin et al. 2018) included beyond
+parity because the stacked-[N, P] design makes them one sort apiece.
+
+Per node i the candidate set is {own_i} ∪ {bcast_j : j ∈ N(i)} — same
+candidate semantics as Krum (krum.py:45: the node's own *true* state plus
+the neighbors' broadcasts).  Both rules gather an [N, m, P] candidate
+tensor (m = max_candidates, injected by the factories as max-degree+1 on
+static graphs, same as Krum's candidate blocks) and reduce along the
+candidate axis, so the working set is O(N·m·P) — sized for sparse graphs;
+on dense graphs m approaches N and the gather approaches the full
+cross-product.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    AggregatorDef,
+    candidate_indices,
+)
+
+
+def _candidate_tensor(own, bcast, adj, m_cap):
+    """Gathered [N, m, P] candidate states plus the [N, m] validity mask
+    (ordering: base.candidate_indices, shared with Krum's candidate
+    blocks).  The self candidate takes the node's own true state."""
+    n = own.shape[0]
+    cand_idx, valid = candidate_indices(adj, m_cap)
+    cand = bcast[cand_idx]  # [N, m, P]
+    is_self = cand_idx == jnp.arange(n)[:, None]
+    cand = jnp.where(is_self[:, :, None], own[:, None, :], cand)
+    return cand, valid
+
+
+def make_coordinate_median(
+    max_candidates: Optional[int] = None, **_params
+) -> AggregatorDef:
+    """Coordinate-wise median over own + neighbor states."""
+    mc = None if max_candidates is None else int(max_candidates)
+
+    def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        n = own.shape[0]
+        m_cap = n if mc is None else min(mc, n)
+        cand, valid = _candidate_tensor(own, bcast, adj, m_cap)
+        cnt = valid.sum(axis=1)  # [N] >= 1 (self always valid)
+        # Invalid candidates are +inf-padded and sort to the END, so the
+        # median indices (cnt-1)//2 and cnt//2 address only the first cnt
+        # (valid) rows.
+        ranked = jnp.sort(
+            jnp.where(valid[:, :, None], cand, jnp.inf), axis=1
+        )
+        lo = jnp.take_along_axis(ranked, ((cnt - 1) // 2)[:, None, None], axis=1)
+        hi = jnp.take_along_axis(ranked, (cnt // 2)[:, None, None], axis=1)
+        new_flat = (0.5 * (lo + hi))[:, 0, :]
+        return new_flat, state, {"num_candidates": cnt.astype(jnp.float32)}
+
+    return AggregatorDef(name="median", aggregate=aggregate)
+
+
+def make_trimmed_mean(
+    trim_ratio: float = 0.2,
+    max_candidates: Optional[int] = None,
+    **_params,
+) -> AggregatorDef:
+    """Coordinate-wise beta-trimmed mean: drop the floor(beta*cnt) smallest
+    and largest values per coordinate, average the rest."""
+    beta = float(trim_ratio)
+    if not 0.0 <= beta < 0.5:
+        raise ValueError(f"trim_ratio must be in [0, 0.5), got {beta}")
+    mc = None if max_candidates is None else int(max_candidates)
+
+    def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
+        n = own.shape[0]
+        m_cap = n if mc is None else min(mc, n)
+        cand, valid = _candidate_tensor(own, bcast, adj, m_cap)
+        cnt = valid.sum(axis=1)  # [N]
+        trim = jnp.floor(beta * cnt).astype(cnt.dtype)  # [N]
+        ranked = jnp.sort(
+            jnp.where(valid[:, :, None], cand, jnp.inf), axis=1
+        )
+        pos = jnp.arange(m_cap)[None, :]  # [1, m]
+        keep = (pos >= trim[:, None]) & (pos < (cnt - trim)[:, None])  # [N, m]
+        kept = jnp.where(keep[:, :, None], ranked, 0.0).sum(axis=1)
+        denom = jnp.maximum(cnt - 2 * trim, 1)[:, None].astype(own.dtype)
+        new_flat = kept / denom
+        return new_flat, state, {
+            "num_candidates": cnt.astype(jnp.float32),
+            "trimmed_per_side": trim.astype(jnp.float32),
+        }
+
+    return AggregatorDef(name="trimmed_mean", aggregate=aggregate)

@@ -41,7 +41,9 @@ class AggregationConfig(_Strict):
     """Aggregation rule selection (reference: murmura/config/schema.py:73-81)."""
 
     algorithm: Literal[
-        "fedavg", "krum", "balance", "sketchguard", "ubar", "evidential_trust"
+        "fedavg", "krum", "balance", "sketchguard", "ubar", "evidential_trust",
+        # Beyond reference parity (coordinate-wise robust statistics):
+        "median", "trimmed_mean",
     ] = Field(description="Aggregation algorithm")
     params: Dict[str, Any] = Field(
         default_factory=dict, description="Algorithm-specific parameters"

@@ -172,17 +172,23 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
                 f"tpu.exchange: ppermute requires a circulant topology "
                 f"(ring/k-regular); '{config.topology.type}' is not"
             )
+        if config.aggregation.algorithm in ("median", "trimmed_mean"):
+            raise ValueError(
+                f"tpu.exchange: ppermute has no circulant path for "
+                f"'{config.aggregation.algorithm}' (coordinate-wise sorts "
+                "need the gathered candidate tensor); use exchange: allgather"
+            )
         agg_params["exchange_offsets"] = offsets
     if (
-        config.aggregation.algorithm == "krum"
+        config.aggregation.algorithm in ("krum", "median", "trimmed_mean")
         and mobility is None
         and config.dmtt is None
     ):
-        # Static graph: bound Krum's per-node candidate block at
-        # max-degree+1 so the vmapped selection gathers [N, m, m] instead
-        # of sorting per-node [N, N] copies (O(N^3) at m = N).  Dynamic
-        # graphs (mobility/DMTT TopB) have no static degree bound and keep
-        # the dense default.
+        # Static graph: bound the per-node candidate block at max-degree+1
+        # so the candidate-gathering rules work on [N, m, ...] instead of
+        # per-node [N, N, ...] copies (O(N^3) at m = N).  Dynamic graphs
+        # (mobility/DMTT TopB) have no static degree bound and keep the
+        # dense default.
         agg_params.setdefault(
             "max_candidates", int(topology.mask().sum(axis=1).max()) + 1
         )

@@ -441,3 +441,68 @@ class TestUnknownAlgorithm:
     def test_raises(self):
         with pytest.raises(ValueError):
             build_aggregator("median_of_means", {})
+
+
+class TestRobustStats:
+    """Beyond-parity rules: coordinate-wise median / trimmed mean
+    (robust_stats.py; no reference counterpart)."""
+
+    def test_median_ignores_extreme_minority(self):
+        # 4 nodes fully connected: candidates everywhere = all 4 states.
+        # One Byzantine broadcast at +1000 cannot move the median of 4
+        # values beyond the span of the honest 3.
+        own = np.array([[1.0], [2.0], [3.0], [1000.0]], dtype=np.float32)
+        agg = build_aggregator("median", {})
+        new, _, stats = _run(agg, own, _full_adj(4))
+        # median of {1,2,3,1000} = (2+3)/2 = 2.5 for every node
+        np.testing.assert_allclose(np.asarray(new), 2.5, atol=1e-6)
+        assert np.asarray(stats["num_candidates"]).tolist() == [4.0] * 4
+
+    def test_median_respects_topology_and_own_state(self):
+        # Ring of 4: node 0's candidates = {own_0, bcast_1, bcast_3}.
+        own = np.array([[0.0], [10.0], [20.0], [30.0]], dtype=np.float32)
+        bcast = own.copy()
+        agg = build_aggregator("median", {})
+        new, _, _ = _run(agg, own, _ring_adj(4), bcast=bcast)
+        # node 0: median{0,10,30} = 10; node 1: median{10,0,20} = 10
+        np.testing.assert_allclose(np.asarray(new)[:2, 0], [10.0, 10.0], atol=1e-6)
+
+    def test_median_uses_own_true_state_not_broadcast(self):
+        own = np.zeros((3, 2), dtype=np.float32)
+        bcast = own.copy()
+        bcast[0] = 500.0  # node 0 lies outward but keeps its true state
+        agg = build_aggregator("median", {})
+        new, _, _ = _run(agg, own, _full_adj(3), bcast=bcast)
+        # node 0's own candidate is its true 0-state: median{0,0,0} = 0
+        np.testing.assert_allclose(np.asarray(new)[0], 0.0, atol=1e-6)
+
+    def test_trimmed_mean_drops_tails(self):
+        own = np.array([[0.0], [1.0], [2.0], [3.0], [1000.0]], dtype=np.float32)
+        # beta=0.2, cnt=5 -> trim 1 per side: mean{1,2,3} = 2 everywhere
+        agg = build_aggregator("trimmed_mean", {"trim_ratio": 0.2})
+        new, _, stats = _run(agg, own, _full_adj(5))
+        np.testing.assert_allclose(np.asarray(new), 2.0, atol=1e-5)
+        assert np.asarray(stats["trimmed_per_side"]).tolist() == [1.0] * 5
+
+    def test_trimmed_mean_zero_trim_is_masked_mean(self):
+        rng = np.random.default_rng(4)
+        own = rng.normal(size=(5, 8)).astype(np.float32)
+        agg = build_aggregator("trimmed_mean", {"trim_ratio": 0.0})
+        new, _, _ = _run(agg, own, _ring_adj(5))
+        for i in range(5):
+            expect = own[[i, (i - 1) % 5, (i + 1) % 5]].mean(axis=0)
+            np.testing.assert_allclose(np.asarray(new)[i], expect, atol=1e-5)
+
+    def test_capped_candidates_match_dense(self):
+        rng = np.random.default_rng(5)
+        n = 10
+        own = rng.normal(size=(n, 6)).astype(np.float32)
+        adj = _ring_adj(n)
+        for algo in ("median", "trimmed_mean"):
+            dense = build_aggregator(algo, {})
+            capped = build_aggregator(algo, {"max_candidates": 3})
+            new_d, _, _ = _run(dense, own, adj)
+            new_c, _, _ = _run(capped, own, adj)
+            np.testing.assert_allclose(
+                np.asarray(new_d), np.asarray(new_c), atol=1e-6
+            )

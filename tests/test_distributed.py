@@ -105,6 +105,25 @@ class TestLocalNode:
         node.aggregate_with_neighbors({2: own + 2.0}, 0)
         np.testing.assert_allclose(node.get_flat_state(), own + 1.0, atol=1e-4)
 
+    def test_median_on_mini_network(self):
+        # Beyond-parity rules run unchanged on the ZMQ mini-network tensor:
+        # slot 0 = self, arrived neighbors in slots, absentees masked.
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.models.mlp import make_mlp
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(32, 4)).astype(np.float32)
+        y = rng.integers(0, 2, size=32).astype(np.int32)
+        node = LocalNode(
+            0, make_mlp(4, (8,), 2), build_aggregator("median", {}),
+            x, y, max_neighbors=3, batch_size=8, seed=1,
+        )
+        own = node.get_flat_state()
+        # candidates {own, own+1, own+1000}: median = own+1 coordinate-wise
+        node.aggregate_with_neighbors({1: own + 1.0, 2: own + 1000.0}, 0)
+        np.testing.assert_allclose(node.get_flat_state(), own + 1.0, atol=1e-4)
+
     def test_edge_state_projection_evidential(self):
         from murmura_tpu.aggregation import build_aggregator
         from murmura_tpu.distributed.local import LocalNode

@@ -99,3 +99,26 @@ def test_fused_with_mobility_adjacency_stack():
         rounds=4, eval_every=2, rounds_per_dispatch=4
     )
     _assert_history_close(base, fused)
+
+
+def test_fused_dmtt_trust_state_carries_through_scan():
+    # The probe-heavy program shape: DMTT Beta-evidence trust ([N, N] edge
+    # state), claim verification against the host-computed G^t stack, and
+    # TopB gating must round-trip the scan carry identically to per-round
+    # dispatch.
+    extra = {
+        "mobility": {"area_size": 50.0, "comm_range": 30.0, "max_speed": 5.0,
+                      "seed": 3},
+        "aggregation": {"algorithm": "evidential_trust",
+                         "params": {"max_eval_samples": 8}},
+        "attack": {"enabled": True, "type": "topology_liar",
+                    "percentage": 0.25,
+                    "params": {"model_attack_type": "gaussian",
+                               "noise_std": 5.0}},
+        "dmtt": {"budget_B": 3},
+    }
+    base = build_network_from_config(_cfg(**extra)).train(rounds=4, eval_every=2)
+    fused = build_network_from_config(_cfg(**extra)).train(
+        rounds=4, eval_every=2, rounds_per_dispatch=2
+    )
+    _assert_history_close(base, fused)

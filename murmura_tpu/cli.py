@@ -18,6 +18,30 @@ from murmura_tpu.utils.seed import set_seed
 console = Console()
 
 
+def _load_config_or_die(config_path: Path):
+    """Load a config, rendering validation/parse failures as readable
+    errors instead of raw tracebacks (a long-standing CLI friction)."""
+    import json as _json
+
+    import pydantic
+    import yaml
+
+    try:
+        return load_config(config_path)
+    except pydantic.ValidationError as e:
+        console.print(f"[bold red]Invalid config[/bold red] {config_path}:")
+        for err in e.errors():
+            loc = ".".join(str(p) for p in err["loc"]) or "<root>"
+            console.print(f"  [yellow]{loc}[/yellow]: {err['msg']}")
+        raise SystemExit(1)
+    except (yaml.YAMLError, _json.JSONDecodeError, ValueError) as e:
+        # Malformed YAML/JSON or an unsupported file suffix.
+        console.print(
+            f"[bold red]Cannot parse config[/bold red] {config_path}: {e}"
+        )
+        raise SystemExit(1)
+
+
 @click.group()
 def app():
     """murmura_tpu: TPU-native decentralized federated learning."""
@@ -44,7 +68,7 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         import jax
 
         jax.config.update("jax_platforms", device)
-    config = load_config(config_path)
+    config = _load_config_or_die(config_path)
     if verbose is not None:
         config.experiment.verbose = verbose
 
@@ -66,9 +90,19 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
 
         history = DistributedRunner(config).run()
     else:
-        from murmura_tpu.utils.factories import build_network_from_config
+        from murmura_tpu.utils.factories import (
+            ConfigError,
+            build_network_from_config,
+        )
 
-        network = build_network_from_config(config)
+        try:
+            network = build_network_from_config(config)
+        except ConfigError as e:
+            # Wiring-level config errors (data/model mismatch, unsupported
+            # exchange mode, ...) — render the message, not the traceback.
+            # Unexpected exceptions stay loud.
+            console.print(f"[bold red]Config error:[/bold red] {e}")
+            raise SystemExit(1)
         if resume:
             if checkpoint_dir is None:
                 raise click.UsageError("--resume requires --checkpoint-dir")
@@ -109,7 +143,7 @@ def run_node(config_path: Path, node_id, t_start, run_id, host):
     """Multi-machine ZMQ worker (reference: cli.py:143-208)."""
     from murmura_tpu.distributed.node_process import run_single_node
 
-    config = load_config(config_path)
+    config = _load_config_or_die(config_path)
     run_single_node(
         config, node_id=node_id, t_start=t_start, run_id=run_id, host=host
     )

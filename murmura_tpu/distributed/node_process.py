@@ -94,12 +94,14 @@ class NodeProcess:
         from murmura_tpu.aggregation import build_aggregator
         from murmura_tpu.data.registry import build_federated_data
         from murmura_tpu.distributed.local import LocalNode
-        from murmura_tpu.models.registry import build_model
         from murmura_tpu.topology.generators import create_topology
-        from murmura_tpu.utils.factories import build_attack, build_mobility
+        from murmura_tpu.utils.factories import (
+            build_attack,
+            build_mobility,
+            resolve_model,
+        )
 
         cfg = self.config
-        model = build_model(cfg.model.factory, cfg.model.params)
         data = build_federated_data(
             cfg.data.adapter,
             cfg.data.params,
@@ -107,6 +109,9 @@ class NodeProcess:
             seed=cfg.experiment.seed,
             max_samples=cfg.training.max_samples,
         )
+        # Shared model construction: wearables input_dim auto-sync + the
+        # fail-fast data/model shape check, same as the in-process backends.
+        model = resolve_model(cfg, data)
         x, y = data.get_client_data(self.node_id)
         # Only pass separate eval arrays when a real test split exists;
         # otherwise LocalNode aliases its training shard (no second device

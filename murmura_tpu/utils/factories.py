@@ -8,6 +8,8 @@ distributed backend reuses the component builders for its per-process nodes.
 
 from typing import Optional
 
+import numpy as np
+
 from murmura_tpu.aggregation import build_aggregator
 from murmura_tpu.attacks import ATTACKS
 from murmura_tpu.attacks.base import Attack
@@ -83,6 +85,58 @@ def build_mobility(config: Config) -> Optional[MobilityModel]:
     )
 
 
+class ConfigError(ValueError):
+    """Wiring-level configuration error: the config validated structurally
+    but its pieces cannot work together (data/model mismatch, unsupported
+    exchange mode, ...).  The CLI renders these as messages, not
+    tracebacks; unexpected ValueErrors stay loud."""
+
+
+def resolve_model(config: Config, data) -> "Model":
+    """Build the model for a config with data-aware parameter sync and a
+    fail-fast shape check.
+
+    Shared by the in-process backends (build_network_from_config) and the
+    ZMQ worker processes (NodeProcess._build_node), so every backend gets
+    the wearables input_dim auto-sync and the data/model consistency error
+    instead of a raw XLA dot_general failure rounds later.
+    """
+    model_params = dict(config.model.params)
+    if config.backend == "tpu":
+        # MXU mixed precision: bfloat16 matmul/conv inputs, float32 params
+        # and accumulation (tpu.compute_dtype, default bfloat16).
+        model_params.setdefault("compute_dtype", config.tpu.compute_dtype)
+    if (
+        "wearables." in config.model.factory
+        and "input_dim" not in model_params
+        and data.x.ndim == 3
+    ):
+        # Window params on the data side (window_size, include_heart_rate)
+        # change the sample dimensionality; keep the model input in sync
+        # unless the user pinned it explicitly.
+        model_params["input_dim"] = int(data.x.shape[-1])
+    model = build_model(config.model.factory, model_params)
+
+    # Compare element counts, not shapes: models accept layout-equivalent
+    # inputs (e.g. [28, 28] images for a [28, 28, 1] CNN input).
+    sample_shape = tuple(data.x.shape[2:])
+    if (
+        model.input_shape
+        and sample_shape
+        and int(np.prod(sample_shape)) != int(np.prod(model.input_shape))
+    ):
+        raise ConfigError(
+            f"data/model mismatch: adapter '{config.data.adapter}' yields "
+            f"samples of shape {sample_shape} "
+            f"({int(np.prod(sample_shape))} values) but model factory "
+            f"'{config.model.factory}' expects input_shape "
+            f"{tuple(model.input_shape)} ({int(np.prod(model.input_shape))} "
+            "values); set model.params.input_dim (or the adapter's shape "
+            "params) so they agree"
+        )
+    return model
+
+
 def apply_compilation_cache(config: Config) -> None:
     """Enable JAX's persistent compilation cache when configured.
 
@@ -126,21 +180,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         seed=seed,
         max_samples=config.training.max_samples,
     )
-    model_params = dict(config.model.params)
-    if config.backend == "tpu":
-        # MXU mixed precision: bfloat16 matmul/conv inputs, float32 params
-        # and accumulation (tpu.compute_dtype, default bfloat16).
-        model_params.setdefault("compute_dtype", config.tpu.compute_dtype)
-    if (
-        "wearables." in config.model.factory
-        and "input_dim" not in model_params
-        and data.x.ndim == 3
-    ):
-        # Window params on the data side (window_size, include_heart_rate)
-        # change the sample dimensionality; keep the model input in sync
-        # unless the user pinned it explicitly.
-        model_params["input_dim"] = int(data.x.shape[-1])
-    model = build_model(config.model.factory, model_params)
+    model = resolve_model(config, data)
 
     topology = create_topology(
         config.topology.type,
@@ -162,18 +202,18 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         # in all six rules; krum assembles its candidate-pair distances
         # from rolled delta vectors instead of the global Gram matrix).
         if mobility is not None or config.dmtt is not None:
-            raise ValueError(
+            raise ConfigError(
                 "tpu.exchange: ppermute requires a static circulant topology "
                 "(mobility/dmtt graphs change per round)"
             )
         offsets = topology.circulant_offsets()
         if offsets is None:
-            raise ValueError(
+            raise ConfigError(
                 f"tpu.exchange: ppermute requires a circulant topology "
                 f"(ring/k-regular); '{config.topology.type}' is not"
             )
         if config.aggregation.algorithm in ("median", "trimmed_mean"):
-            raise ValueError(
+            raise ConfigError(
                 f"tpu.exchange: ppermute has no circulant path for "
                 f"'{config.aggregation.algorithm}' (coordinate-wise sorts "
                 "need the gathered candidate tensor); use exchange: allgather"

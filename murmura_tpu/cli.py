@@ -21,10 +21,9 @@ console = Console()
 def _load_config_or_die(config_path: Path):
     """Load a config, rendering validation/parse failures as readable
     errors instead of raw tracebacks (a long-standing CLI friction)."""
-    import json as _json
-
     import pydantic
     import yaml
+    from rich.markup import escape
 
     try:
         return load_config(config_path)
@@ -32,12 +31,15 @@ def _load_config_or_die(config_path: Path):
         console.print(f"[bold red]Invalid config[/bold red] {config_path}:")
         for err in e.errors():
             loc = ".".join(str(p) for p in err["loc"]) or "<root>"
-            console.print(f"  [yellow]{loc}[/yellow]: {err['msg']}")
+            console.print(f"  [yellow]{escape(loc)}[/yellow]: {escape(err['msg'])}")
         raise SystemExit(1)
-    except (yaml.YAMLError, _json.JSONDecodeError, ValueError) as e:
-        # Malformed YAML/JSON or an unsupported file suffix.
+    except (yaml.YAMLError, json.JSONDecodeError, ValueError) as e:
+        # Malformed YAML/JSON or an unsupported file suffix.  escape():
+        # error text may contain [bracketed] segments rich would otherwise
+        # swallow as markup tags.
         console.print(
-            f"[bold red]Cannot parse config[/bold red] {config_path}: {e}"
+            f"[bold red]Cannot parse config[/bold red] {config_path}: "
+            f"{escape(str(e))}"
         )
         raise SystemExit(1)
 
@@ -87,8 +89,15 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
                 "backend: distributed (state lives in per-node processes)"
             )
         from murmura_tpu.distributed.runner import DistributedRunner
+        from murmura_tpu.utils.factories import ConfigError
 
-        history = DistributedRunner(config).run()
+        try:
+            history = DistributedRunner(config).run()
+        except ConfigError as e:
+            from rich.markup import escape
+
+            console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
+            raise SystemExit(1)
     else:
         from murmura_tpu.utils.factories import (
             ConfigError,
@@ -101,7 +110,9 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
             # Wiring-level config errors (data/model mismatch, unsupported
             # exchange mode, ...) — render the message, not the traceback.
             # Unexpected exceptions stay loud.
-            console.print(f"[bold red]Config error:[/bold red] {e}")
+            from rich.markup import escape
+
+            console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
             raise SystemExit(1)
         if resume:
             if checkpoint_dir is None:
@@ -142,11 +153,18 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
 def run_node(config_path: Path, node_id, t_start, run_id, host):
     """Multi-machine ZMQ worker (reference: cli.py:143-208)."""
     from murmura_tpu.distributed.node_process import run_single_node
+    from murmura_tpu.utils.factories import ConfigError
 
     config = _load_config_or_die(config_path)
-    run_single_node(
-        config, node_id=node_id, t_start=t_start, run_id=run_id, host=host
-    )
+    try:
+        run_single_node(
+            config, node_id=node_id, t_start=t_start, run_id=run_id, host=host
+        )
+    except ConfigError as e:
+        from rich.markup import escape
+
+        console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
+        raise SystemExit(1)
 
 
 @app.command("list-components")

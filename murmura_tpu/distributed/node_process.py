@@ -30,9 +30,20 @@ from murmura_tpu.distributed.messaging import (
 def _force_cpu_jax() -> None:
     """Child processes must not contend for the single-tenant TPU; local
     training in the ZMQ backend runs on CPU (the tpu backend is the device
-    path)."""
+    path).
+
+    The env mutation alone is NOT enough: jax captures JAX_PLATFORMS when
+    it is imported, and the package import (``python -m murmura_tpu`` /
+    a spawned worker) happens before this runs.  jax.config.update works
+    as long as no backend has initialized yet — same technique as
+    tests/conftest.py.  Without it, workers on a machine with a wedged
+    TPU plugin hang inside device init instead of training on CPU.
+    """
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
 
 
 class NodeProcess:

@@ -66,6 +66,24 @@ class DistributedRunner:
                     "available in this build"
                 )
 
+        # Same fail-fast principle for data/model wiring: a mismatch would
+        # otherwise crash all N children with raw tracebacks while the head
+        # idles on monitor.join for the full time budget.  resolve_model
+        # raises ConfigError with the config-level explanation.
+        from murmura_tpu.data.registry import build_federated_data
+        from murmura_tpu.utils.factories import resolve_model
+
+        resolve_model(
+            self.config,
+            build_federated_data(
+                self.config.data.adapter,
+                self.config.data.params,
+                num_nodes=self.config.topology.num_nodes,
+                seed=self.config.experiment.seed,
+                max_samples=self.config.training.max_samples,
+            ),
+        )
+
         # Children must boot clean of the single-tenant TPU plugin: the axon
         # sitecustomize registers at interpreter start (before any code in
         # the child runs), so strip the trigger env for the spawn window —

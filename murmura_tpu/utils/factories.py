@@ -92,7 +92,7 @@ class ConfigError(ValueError):
     tracebacks; unexpected ValueErrors stay loud."""
 
 
-def resolve_model(config: Config, data) -> "Model":
+def resolve_model(config: Config, data):
     """Build the model for a config with data-aware parameter sync and a
     fail-fast shape check.
 

@@ -10,6 +10,7 @@ from pathlib import Path
 
 import click
 from rich.console import Console
+from rich.markup import escape
 from rich.table import Table
 
 from murmura_tpu.config import load_config
@@ -18,12 +19,19 @@ from murmura_tpu.utils.seed import set_seed
 console = Console()
 
 
+def _die_config_error(e: Exception) -> None:
+    """Render a wiring-level ConfigError and exit (shared by every CLI
+    path; escape(): error text may contain [bracketed] segments rich would
+    otherwise swallow as markup tags)."""
+    console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
+    raise SystemExit(1)
+
+
 def _load_config_or_die(config_path: Path):
     """Load a config, rendering validation/parse failures as readable
     errors instead of raw tracebacks (a long-standing CLI friction)."""
     import pydantic
     import yaml
-    from rich.markup import escape
 
     try:
         return load_config(config_path)
@@ -94,10 +102,7 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         try:
             history = DistributedRunner(config).run()
         except ConfigError as e:
-            from rich.markup import escape
-
-            console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
-            raise SystemExit(1)
+            _die_config_error(e)
     else:
         from murmura_tpu.utils.factories import (
             ConfigError,
@@ -110,10 +115,7 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
             # Wiring-level config errors (data/model mismatch, unsupported
             # exchange mode, ...) — render the message, not the traceback.
             # Unexpected exceptions stay loud.
-            from rich.markup import escape
-
-            console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
-            raise SystemExit(1)
+            _die_config_error(e)
         if resume:
             if checkpoint_dir is None:
                 raise click.UsageError("--resume requires --checkpoint-dir")
@@ -161,10 +163,7 @@ def run_node(config_path: Path, node_id, t_start, run_id, host):
             config, node_id=node_id, t_start=t_start, run_id=run_id, host=host
         )
     except ConfigError as e:
-        from rich.markup import escape
-
-        console.print(f"[bold red]Config error:[/bold red] {escape(str(e))}")
-        raise SystemExit(1)
+        _die_config_error(e)
 
 
 @app.command("list-components")

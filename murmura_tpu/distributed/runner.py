@@ -70,6 +70,8 @@ class DistributedRunner:
         # otherwise crash all N children with raw tracebacks while the head
         # idles on monitor.join for the full time budget.  resolve_model
         # raises ConfigError with the config-level explanation.
+        # max_samples=32 keeps the head's throwaway stacking cheap — the
+        # shape check only needs one sample's dimensionality.
         from murmura_tpu.data.registry import build_federated_data
         from murmura_tpu.utils.factories import resolve_model
 
@@ -80,7 +82,7 @@ class DistributedRunner:
                 self.config.data.params,
                 num_nodes=self.config.topology.num_nodes,
                 seed=self.config.experiment.seed,
-                max_samples=self.config.training.max_samples,
+                max_samples=min(32, self.config.training.max_samples or 32),
             ),
         )
 

@@ -40,6 +40,38 @@ def test_run_writes_history_json(tmp_path):
     assert hist["round"] == [1, 2]
 
 
+def test_run_fused_dispatch_from_config(tmp_path):
+    cfg = _write_cfg(tmp_path, tpu={"rounds_per_dispatch": 2})
+    out = tmp_path / "hist.json"
+    result = CliRunner().invoke(app, ["run", str(cfg), "-o", str(out)])
+    assert result.exit_code == 0, result.output
+    hist = json.loads(out.read_text())
+    assert hist["round"] == [1, 2]
+
+
+def test_run_renders_wiring_error_cleanly(tmp_path):
+    # data 8-dim vs model 16-dim: ConfigError message, no traceback.
+    cfg = _write_cfg(
+        tmp_path,
+        model={"factory": "mlp",
+                "params": {"input_dim": 16, "hidden_dims": [16],
+                           "num_classes": 3}},
+    )
+    result = CliRunner().invoke(app, ["run", str(cfg)])
+    assert result.exit_code == 1
+    assert "data/model mismatch" in result.output
+    assert "Traceback" not in result.output
+
+
+def test_run_renders_parse_error_cleanly(tmp_path):
+    p = tmp_path / "broken.yaml"
+    p.write_text("experiment: {name: x\n  nope")
+    result = CliRunner().invoke(app, ["run", str(p)])
+    assert result.exit_code == 1
+    assert "Cannot parse config" in result.output
+    assert "Traceback" not in result.output
+
+
 def test_run_resume_requires_checkpoint_dir(tmp_path):
     cfg = _write_cfg(tmp_path)
     result = CliRunner().invoke(app, ["run", str(cfg), "--resume"])

@@ -151,6 +151,10 @@ def make_krum(
             jnp.arange(n), cand_idx, valid
         )
         # Winner index == self means "own state"; otherwise take the broadcast.
+        # Row selection stays a gather: a one-hot matmul would be faster on
+        # TPU (same pathology as the attack's old scatter) but 0*inf = NaN
+        # propagates any single non-finite Byzantine broadcast to EVERY
+        # node's output, breaking exactly the isolation Krum exists for.
         selected_own = winners == jnp.arange(n)
         new_flat = jnp.where(selected_own[:, None], own, bcast[winners])
         stats = {

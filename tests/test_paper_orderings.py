@@ -63,3 +63,17 @@ def test_committed_dmtt_ordering():
 def test_committed_matrix_is_complete():
     ok = _completed_records()
     assert len(ok) >= 252, f"only {len(ok)} experiments ok"
+
+
+def test_extras_robust_stats_orderings():
+    """The committed beyond-parity evidence run (median/trimmed_mean vs
+    fedavg, experiments/extras/) must satisfy its own checks — regenerate
+    with run_robust_stats.py after changing anything that moves accuracy."""
+    extras = (
+        Path(__file__).parent.parent / "experiments" / "extras" / "results.json"
+    )
+    if not extras.exists():
+        pytest.skip("no committed extras results.json")
+    blob = json.loads(extras.read_text())
+    failing = [k for k, v in blob["checks"].items() if not v]
+    assert blob["all_pass"], f"failing checks: {failing}"

@@ -101,6 +101,22 @@ def test_fused_with_mobility_adjacency_stack():
     _assert_history_close(base, fused)
 
 
+def test_fused_checkpoint_resume_matches_straight_run(tmp_path):
+    # fold_in(base, round) keys make a resumed fused run reproduce the
+    # uninterrupted one exactly.
+    straight = build_network_from_config(_cfg()).train(
+        rounds=6, eval_every=2, rounds_per_dispatch=2
+    )
+
+    first = build_network_from_config(_cfg())
+    first.train(rounds=4, eval_every=2, rounds_per_dispatch=2,
+                checkpoint_dir=str(tmp_path), checkpoint_every=2)
+    resumed = build_network_from_config(_cfg())
+    assert resumed.restore_checkpoint(str(tmp_path)) == 4
+    history = resumed.train(rounds=2, eval_every=2, rounds_per_dispatch=2)
+    _assert_history_close(straight, history)
+
+
 def test_fused_dmtt_trust_state_carries_through_scan():
     # The probe-heavy program shape: DMTT Beta-evidence trust ([N, N] edge
     # state), claim verification against the host-computed G^t stack, and

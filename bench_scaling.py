@@ -166,6 +166,20 @@ def main():
     on_cpu = "cpu" in backend
 
     results = []
+
+    def flush(done: bool) -> dict:
+        # Written after EVERY point: a killed sweep (wall-clock budget,
+        # wedged tunnel) still leaves the completed points on disk.
+        blob = {
+            "backend": backend,
+            "device_kind": device_kind,
+            "probe_log": probe_log,
+            "complete": done,
+            "points": results,
+        }
+        Path(args.out).write_text(json.dumps(blob, indent=2) + "\n")
+        return blob
+
     for p in POINTS:
         cmd = [sys.executable, __file__, "--point", str(p["nodes"]),
                p["algo"], p["exchange"]]
@@ -186,15 +200,9 @@ def main():
         except subprocess.TimeoutExpired:
             results.append({**p, "ok": False,
                             "err": f"timeout after {args.timeout}s"})
+        flush(done=False)
 
-    blob = {
-        "backend": backend,
-        "device_kind": device_kind,
-        "probe_log": probe_log,
-        "points": results,
-    }
-    Path(args.out).write_text(json.dumps(blob, indent=2) + "\n")
-    print(json.dumps(blob))
+    print(json.dumps(flush(done=True)))
 
 
 if __name__ == "__main__":

@@ -156,6 +156,10 @@ class Network:
                 round loop so XLA queues rounds back-to-back (throughput
                 mode — history is identical, per-round ``round_times``
                 become dispatch times rather than wall round times).
+                Only meaningful for per-round dispatch: with
+                ``rounds_per_dispatch > 1`` the fused scan already fetches
+                metrics once per chunk, so ``defer_metrics`` is ignored
+                (a warning is emitted).
             rounds_per_dispatch: fuse this many rounds into one
                 ``lax.scan`` program (core.rounds.build_multi_round) — the
                 round loop lives on the device and history comes back as
@@ -169,6 +173,14 @@ class Network:
             jax.profiler.start_trace(self.profile_dir)
         try:
             if rounds_per_dispatch > 1:
+                if defer_metrics:
+                    import warnings
+
+                    warnings.warn(
+                        "defer_metrics is ignored when rounds_per_dispatch > 1: "
+                        "the fused scan already syncs metrics once per chunk",
+                        stacklevel=2,
+                    )
                 self._train_fused(
                     rounds, verbose, eval_every, checkpoint_dir,
                     checkpoint_every, rounds_per_dispatch,
@@ -230,7 +242,12 @@ class Network:
             )
             rows = jax.device_get(rows)
             self.current_round = round0 + k
-            self.round_times.append(time.perf_counter() - t0)
+            # Keep round_times in per-round units across dispatch modes:
+            # one amortized entry per round, not one per chunk (the chunk
+            # runs as a single device program, so per-round wall times
+            # inside it are not observable).
+            elapsed = time.perf_counter() - t0
+            self.round_times.extend([elapsed / k] * k)
             done += k
             for i in range(k):
                 if rows["evaluated"][i]:

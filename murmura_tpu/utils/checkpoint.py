@@ -23,7 +23,11 @@ import jax
 import numpy as np
 from flax import serialization
 
-CKPT_VERSION = 2
+# v3: per-round step keys changed from an advancing split() chain to
+# fold_in(base, round) — the saved rng blob is now the static base key, not
+# chain state.  A v2 checkpoint restored into a v3 build would resume with a
+# silently different noise/SGD stream, so the version gate fails it loudly.
+CKPT_VERSION = 3
 STATE_FILE = "state.msgpack"
 META_FILE = "meta.json"
 
@@ -87,8 +91,15 @@ def restore_checkpoint(
     d = Path(directory)
     meta = json.loads((d / META_FILE).read_text())
     if meta.get("version") != CKPT_VERSION:
+        hint = (
+            " (v2 checkpoints carry split()-chain rng state; this build keys "
+            "rounds as fold_in(base, round), so resuming one would silently "
+            "change the random stream)"
+            if meta.get("version") == 2
+            else ""
+        )
         raise ValueError(
-            f"Checkpoint version {meta.get('version')} != {CKPT_VERSION}"
+            f"Checkpoint version {meta.get('version')} != {CKPT_VERSION}{hint}"
         )
     state = serialization.from_bytes(
         {

@@ -115,3 +115,20 @@ def test_defer_metrics_history_identical():
     np.testing.assert_allclose(
         sync.history["mean_loss"], deferred.history["mean_loss"]
     )
+
+
+def test_stale_checkpoint_version_rejected(tmp_path):
+    """A v2 checkpoint (split()-chain rng semantics) must fail loudly, not
+    resume with a silently different random stream."""
+    import json
+    import pytest
+
+    net = _make_network()
+    net.train(rounds=2, checkpoint_dir=str(tmp_path))
+    meta_path = tmp_path / "meta.json"
+    meta = json.loads(meta_path.read_text())
+    meta["version"] = 2
+    meta_path.write_text(json.dumps(meta))
+    fresh = _make_network()
+    with pytest.raises(ValueError, match="fold_in"):
+        fresh.restore_checkpoint(str(tmp_path))

@@ -138,3 +138,18 @@ def test_fused_dmtt_trust_state_carries_through_scan():
         rounds=4, eval_every=2, rounds_per_dispatch=2
     )
     _assert_history_close(base, fused)
+
+
+def test_fused_round_times_are_per_round_and_defer_metrics_warns():
+    # round_times must stay in per-round units across dispatch modes
+    # (one amortized entry per round, not one per chunk), and
+    # defer_metrics — meaningless under fused dispatch — must warn.
+    import warnings
+
+    net = build_network_from_config(_cfg())
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        net.train(rounds=6, eval_every=2, rounds_per_dispatch=4,
+                  defer_metrics=True)
+    assert len(net.round_times) == 6
+    assert any("defer_metrics is ignored" in str(w.message) for w in caught)

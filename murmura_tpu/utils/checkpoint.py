@@ -92,9 +92,10 @@ def restore_checkpoint(
     meta = json.loads((d / META_FILE).read_text())
     if meta.get("version") != CKPT_VERSION:
         hint = (
-            " (v2 checkpoints carry split()-chain rng state; this build keys "
-            "rounds as fold_in(base, round), so resuming one would silently "
-            "change the random stream)"
+            " (the rng blob in a v2 checkpoint is ambiguous: depending on the "
+            "build that wrote it, it is either split()-chain state or the "
+            "fold_in base key this build expects — resuming the former would "
+            "silently change the random stream, so both are rejected)"
             if meta.get("version") == 2
             else ""
         )

@@ -72,39 +72,60 @@ def _timed_eval(ev, params, d, k1=5, k2=45):
     return (t2 - t1) / (k2 - k1)
 
 
-def build(algo: str, local_epochs: int):
+FLAGSHIP_CFG = {
+    "experiment": {"name": "breakdown", "seed": 7, "rounds": 10},
+    "topology": {"type": "k-regular", "num_nodes": 20, "k": 4},
+    "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+    "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
+                "params": {"noise_std": 10.0}},
+    "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+    "data": {
+        "adapter": "synthetic",
+        "params": {"num_samples": 160 * 20, "input_shape": [28, 28, 1],
+                    "num_classes": 62},
+    },
+    "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
+    "backend": "tpu",
+    "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
+}
+
+# The probe-heavy scenario: evidential_trust on a 10-node fully-connected
+# UCI-HAR-shaped network — every node cross-evaluates every broadcast state
+# on its local probe batch (the reference's worst hot loop: one deepcopy +
+# sequential forward sweep per neighbor per round,
+# evidential_trust.py:236-260; here one batched [N, N] vmapped forward).
+PROBE_CFG = {
+    "experiment": {"name": "breakdown-probe", "seed": 7, "rounds": 10},
+    "topology": {"type": "fully", "num_nodes": 10},
+    "aggregation": {"algorithm": "evidential_trust",
+                     "params": {"max_eval_samples": 64}},
+    "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
+                "params": {"noise_std": 10.0}},
+    "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+    "data": {
+        "adapter": "wearables.uci_har",
+        "params": {"num_samples": 160 * 10},
+    },
+    "model": {"factory": "wearables.uci_har", "params": {}},
+    "backend": "tpu",
+    "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
+}
+
+
+def build(algo: str, local_epochs: int, raw_cfg=None):
     from murmura_tpu.aggregation import build_aggregator
     from murmura_tpu.aggregation.base import AggregatorDef
     from murmura_tpu.config import Config
     from murmura_tpu.core.rounds import build_round_program
     from murmura_tpu.data.registry import build_federated_data
-    from murmura_tpu.models.registry import build_model
-    from murmura_tpu.utils.factories import build_attack
+    from murmura_tpu.utils.factories import build_attack, resolve_model
 
-    cfg = Config.model_validate(
-        {
-            "experiment": {"name": "breakdown", "seed": 7, "rounds": 10},
-            "topology": {"type": "k-regular", "num_nodes": 20, "k": 4},
-            "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
-            "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
-                        "params": {"noise_std": 10.0}},
-            "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
-            "data": {
-                "adapter": "synthetic",
-                "params": {"num_samples": 160 * 20, "input_shape": [28, 28, 1],
-                            "num_classes": 62},
-            },
-            "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
-            "backend": "tpu",
-            "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
-        }
-    )
+    cfg = Config.model_validate(raw_cfg or FLAGSHIP_CFG)
+    n = cfg.topology.num_nodes
     data = build_federated_data(
-        cfg.data.adapter, cfg.data.params, num_nodes=20, seed=7
+        cfg.data.adapter, cfg.data.params, num_nodes=n, seed=7
     )
-    model = build_model(
-        cfg.model.factory, {"compute_dtype": "bfloat16"}
-    )
+    model = resolve_model(cfg, data)
     if algo == "passthrough":
         agg = AggregatorDef(
             name="passthrough",
@@ -117,13 +138,18 @@ def build(algo: str, local_epochs: int):
             name="passthrough_bcast",
             aggregate=lambda own, bcast, adj, r, state, ctx: (bcast, state, {}),
         )
-    else:
+    elif algo == "krum":
         agg = build_aggregator(algo, {"num_compromised": 1, "max_candidates": 5})
+    else:
+        agg = build_aggregator(
+            algo, dict(cfg.aggregation.params), total_rounds=10
+        )
     attack = build_attack(cfg)
+    probe_size = cfg.aggregation.params.get("max_eval_samples")
     program = build_round_program(
         model, agg, data,
         local_epochs=local_epochs, batch_size=32, lr=0.05, total_rounds=10,
-        attack=attack, seed=7,
+        attack=attack, seed=7, probe_size=probe_size,
     )
     return program, attack
 
@@ -175,11 +201,57 @@ def main():
         "eval_ms": results["eval"]["ms"],
         "full_round_ms": results["krum_e1"]["ms"],
     }
+
+    # Probe-heavy scenario: the same passthrough-vs-full difference
+    # isolates the N x N cross-eval + trust update (the design's biggest
+    # win over the reference's per-neighbor deepcopy loop).
+    probe_results = {}
+    for name, algo, epochs in (
+        ("passthrough_e1", "passthrough_bcast", 1),
+        ("evidential_e1", "evidential_trust", 1),
+    ):
+        program, attack = build(algo, epochs, PROBE_CFG)
+        topo = create_topology("fully", num_nodes=10, seed=12345)
+        p_adj = jnp.asarray(topo.mask())
+        p_comp = jnp.asarray(attack.compromised.astype("float32"))
+        step = jax.jit(program.train_step)
+        d = {k: jnp.asarray(v) for k, v in program.data_arrays.items()}
+        args = (
+            program.init_params,
+            {k: jnp.asarray(v) for k, v in program.init_agg_state.items()},
+            jax.random.PRNGKey(0), p_adj, p_comp,
+            jnp.asarray(0.0, jnp.float32), d,
+        )
+        t0 = time.perf_counter()
+        probe_results[name] = {"ms": round(1e3 * _timed_step(step, args), 3)}
+        probe_results[name]["compile_and_time_s"] = round(
+            time.perf_counter() - t0, 1
+        )
+        if name == "evidential_e1":
+            ev = jax.jit(program.eval_step)
+            probe_results["eval"] = {
+                "ms": round(1e3 * _timed_eval(ev, program.init_params, d), 3)
+            }
+    probe_seg = {
+        "cross_eval_trust_ms": round(
+            probe_results["evidential_e1"]["ms"]
+            - probe_results["passthrough_e1"]["ms"], 3
+        ),
+        "eval_ms": probe_results["eval"]["ms"],
+        "full_round_ms": probe_results["evidential_e1"]["ms"],
+    }
+
     blob = {
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
         "segments": seg,
+        "probe_scenario": {
+            "config": "evidential_trust, 10-node fully, UCI-HAR-shaped, "
+                       "max_eval_samples=64",
+            "segments": probe_seg,
+        },
         "raw": results,
+        "raw_probe": probe_results,
     }
     Path(__file__).with_name("bench_breakdown.json").write_text(
         json.dumps(blob, indent=2) + "\n"

@@ -149,15 +149,36 @@ def generate_all(data_root=None):
                        mk(dataset=ds, algorithm=algo, topology_type=topo,
                           name_suffix=topo))
 
-    # Ablation: evidential_trust hyperparameter sensitivity on UCI HAR
-    # under the 20% gaussian attack (reference Table III).
+    # Ablation: evidential_trust hyperparameter sensitivity, the full
+    # reference grid — 4 params x {5,4,4,4} values x 3 datasets = 51
+    # configs, attack-free / fully-connected / alpha 0.5 exactly like the
+    # reference's ablation category (reference:
+    # experiments/paper/generate_all_configs.py:244-282, Table III).
+    for ds in DATASETS:
+        for param, values in (
+            ("self_weight", (0.3, 0.5, 0.6, 0.7, 0.9)),
+            ("trust_threshold", (0.05, 0.1, 0.2, 0.3)),
+            ("accuracy_weight", (0.3, 0.5, 0.7, 0.9)),
+            ("vacuity_threshold", (0.3, 0.5, 0.7, 0.9)),
+        ):
+            for v in values:
+                yield ("ablation", f"{ds}_et_{param}_{v}",
+                       mk(dataset=ds, algorithm="evidential_trust",
+                          agg_overrides={param: v},
+                          name_suffix=f"{param}{v}"))
+
+    # Beyond the reference grid: the same sensitivity trio measured UNDER
+    # the 20% gaussian attack (the regime the paper's robustness claims
+    # live in).  These were this repo's original ablation cells; kept as
+    # their own category so the reference-matching grid above stays
+    # byte-comparable.
     for param, values in (
         ("self_weight", (0.3, 0.5, 0.7)),
         ("trust_threshold", (0.05, 0.1, 0.2)),
         ("accuracy_weight", (0.5, 0.7, 0.9)),
     ):
         for v in values:
-            yield ("ablation", f"uci_har_et_{param}_{v}",
+            yield ("ablation_attacked", f"uci_har_et_{param}_{v}",
                    mk(dataset="uci_har", algorithm="evidential_trust",
                       attack_enabled=True, attack_type="gaussian",
                       attack_percentage=0.2,

@@ -24,7 +24,8 @@ from pathlib import Path
 PAPER_DIR = Path(__file__).parent
 CONFIG_DIR = PAPER_DIR / "configs"
 RESULTS_DIR = PAPER_DIR / "results"
-CATEGORIES = ["baseline", "heterogeneity", "attacks", "topologies", "ablation"]
+CATEGORIES = ["baseline", "heterogeneity", "attacks", "topologies",
+              "ablation", "ablation_attacked"]
 
 
 def run_one(cfg_path: Path, out_json: Path, timeout: float,

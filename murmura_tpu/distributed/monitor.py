@@ -109,6 +109,11 @@ class Monitor:
         self.history.setdefault("skipped_nodes", []).append(
             len(per_node) - len(rows)
         )
+        # Degradation visibility: how many nodes reported this round at all.
+        # A crashed/stalled node shows up as reporting_nodes < num_nodes on
+        # every partial-flushed round (the reference only logs the missing
+        # set inside each node's stdout — node_process.py:259-269).
+        self.history.setdefault("reporting_nodes", []).append(len(per_node))
         if not rows:
             # Every node overran its training window: keep the round visible
             # with NaN metrics instead of silently producing an empty

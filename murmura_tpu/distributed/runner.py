@@ -46,12 +46,26 @@ def _node_main(config: Config, node_id: int, run_id: str, t_start: float,
 
 
 class DistributedRunner:
-    """Launches monitor + N node processes on this machine."""
+    """Launches monitor + N node processes on this machine.
+
+    ``run()`` is ``start()`` + ``wait()``.  The split exists so callers can
+    reach the spawned processes mid-run — the fault-injection test SIGKILLs
+    a node between rounds and asserts the survivors degrade per the
+    deadline semantics (reference: node_process.py:249-276).
+    """
 
     def __init__(self, config: Config):
         self.config = config
+        self.node_procs: List[Any] = []
+        self.t_start: float = 0.0
+        self._monitor = None
+        self._queue = None
 
     def run(self) -> Dict[str, List[Any]]:
+        self.start()
+        return self.wait()
+
+    def start(self) -> None:
         import importlib.util
         import os
 
@@ -117,15 +131,16 @@ class DistributedRunner:
         )
 
         ctx = mp.get_context("spawn")
-        queue = ctx.Queue()
-        monitor = ctx.Process(
+        self._queue = ctx.Queue()
+        self._monitor = ctx.Process(
             target=_monitor_main,
-            args=(cfg, run_id, t_start, compromised, queue),
+            args=(cfg, run_id, t_start, compromised, self._queue),
             daemon=False,
         )
-        monitor.start()
+        self._monitor.start()
 
-        nodes = []
+        self.t_start = t_start
+        self.node_procs = []
         for node_id in range(cfg.topology.num_nodes):
             p = ctx.Process(
                 target=_node_main,
@@ -133,7 +148,7 @@ class DistributedRunner:
                 daemon=False,
             )
             p.start()
-            nodes.append(p)
+            self.node_procs.append(p)
 
         # All children are spawned; restore the parent's env.
         for k, v in saved_env.items():
@@ -142,6 +157,8 @@ class DistributedRunner:
             else:
                 os.environ[k] = v
 
+    def wait(self) -> Dict[str, List[Any]]:
+        cfg = self.config
         history: Dict[str, List[Any]] = {}
         try:
             # generous join: rounds * duration + grace + hard-deadline margin
@@ -150,15 +167,15 @@ class DistributedRunner:
                 + (cfg.experiment.rounds + 3) * cfg.distributed.round_duration_s
                 + 60.0
             )
-            monitor.join(timeout=budget)
-            if monitor.is_alive():
-                monitor.terminate()
-            while not queue.empty():
-                history = queue.get_nowait()
+            self._monitor.join(timeout=budget)
+            if self._monitor.is_alive():
+                self._monitor.terminate()
+            while not self._queue.empty():
+                history = self._queue.get_nowait()
         finally:
-            for p in nodes:
+            for p in self.node_procs:
                 p.join(timeout=5.0)
-            for p in nodes:
+            for p in self.node_procs:
                 if p.is_alive():
                     p.terminate()
         return history

@@ -191,10 +191,84 @@ class TestFullStack:
         sim_cfg = cfg.model_copy(update={"backend": "simulation"})
         sim_history = build_network_from_config(sim_cfg).train(rounds=2)
         populated = lambda h: {k for k, v in h.items() if len(v) > 0}
-        # skipped_nodes is distributed-only degradation telemetry: it
-        # appears whenever a loaded suite machine makes a worker overrun
-        # its round window (wall-clock rounds), which is legitimate
-        # behavior, not a schema divergence.
-        assert populated(history) - {"skipped_nodes"} == populated(sim_history), (
-            populated(history) ^ populated(sim_history)
+        # skipped_nodes / reporting_nodes are distributed-only degradation
+        # telemetry: they appear whenever a loaded suite machine makes a
+        # worker overrun its round window (wall-clock rounds), which is
+        # legitimate behavior, not a schema divergence.
+        assert populated(history) - {"skipped_nodes", "reporting_nodes"} == (
+            populated(sim_history)
+        ), populated(history) ^ populated(sim_history)
+
+
+@pytest.mark.slow
+class TestFaultInjection:
+    def test_node_killed_mid_run_degrades_gracefully(self, tmp_path):
+        """SIGKILL one node during round 2 of a 6-node IPC run: the
+        survivors must complete every round under the deadline-based
+        partial-aggregation semantics (reference:
+        murmura/distributed/node_process.py:249-276, monitor.py:90-128),
+        the monitor history must show the degraded reporting count, and
+        accuracy must keep improving."""
+        import os
+        import signal
+
+        from murmura_tpu.distributed.runner import DistributedRunner
+
+        rounds, duration = 3, 30.0
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "fault-test", "seed": 42,
+                               "rounds": rounds},
+                "topology": {"type": "ring", "num_nodes": 6},
+                "aggregation": {"algorithm": "fedavg"},
+                "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+                "data": {
+                    "adapter": "synthetic",
+                    "params": {"num_samples": 480, "input_dim": 16,
+                                "num_classes": 4},
+                },
+                "model": {
+                    "factory": "mlp",
+                    "params": {"input_dim": 16, "num_classes": 4,
+                                "hidden_dims": [16]},
+                },
+                "backend": "distributed",
+                "distributed": {
+                    "transport": "ipc",
+                    "ipc_dir": str(tmp_path),
+                    "round_duration_s": duration,
+                    "startup_grace_s": 90.0,  # 7 spawns share one CI core
+                },
+            }
         )
+        runner = DistributedRunner(cfg)
+        runner.start()
+        victim = runner.node_procs[3]
+        try:
+            # Round k occupies [t_start + k*dur, t_start + (k+1)*dur); kill
+            # mid-round-2 (k=1), after round 1's metrics are in flight.
+            while time.monotonic() < runner.t_start + 1.35 * duration:
+                time.sleep(0.5)
+            assert victim.is_alive(), "victim died before injection"
+            os.kill(victim.pid, signal.SIGKILL)
+        finally:
+            history = runner.wait()
+
+        # Survivors completed every round (partial flush at the hard
+        # deadline records the degraded rounds).
+        assert history["round"] == [1, 2, 3], history
+        reporting = history["reporting_nodes"]
+        assert reporting[0] == 6, history  # round 1 was fully reported
+        assert reporting[-1] == 5, history  # final round ran without victim
+        accs = np.asarray(history["mean_accuracy"], dtype=np.float64)
+        # Round 1 may legitimately be NaN on a saturated CI core: all six
+        # workers compile at once and can overrun the first wall-clock
+        # window, which flags their metrics `skipped` (that overrun path is
+        # itself reference semantics).  The post-kill round must be real.
+        assert np.isfinite(accs[-1]), history
+        assert accs[-1] > 0.3, history
+        # Learning persisted through the fault: the final round is at least
+        # as good as every earlier recorded round (small slack for noise).
+        earlier = accs[:-1][np.isfinite(accs[:-1])]
+        if earlier.size:
+            assert accs[-1] >= earlier.max() - 0.05, history

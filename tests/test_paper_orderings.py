@@ -23,10 +23,11 @@ def _completed_records():
         pytest.skip("no committed results.json (run run_comprehensive.py)")
     records = json.loads(RESULTS.read_text())
     ok = [r for r in records if r.get("ok")]
-    # The generator emits 261 configs (3 datasets x 6 algorithms x
-    # (1 + 3 + 6 + 4) + 9 ablation); don't judge a matrix mid-generation.
-    if len(ok) < 252:
-        pytest.skip(f"matrix incomplete ({len(ok)}/261 ok) — still generating")
+    # The generator emits 312 configs (3 datasets x 6 algorithms x
+    # (1 + 3 + 6 + 4) + 51 reference-grid ablation + 9 attacked ablation);
+    # don't judge a matrix mid-generation.
+    if len(ok) < 300:
+        pytest.skip(f"matrix incomplete ({len(ok)}/312 ok) — still generating")
     return ok
 
 
@@ -39,6 +40,14 @@ def test_committed_matrix_satisfies_orderings():
         capture_output=True, text=True, timeout=60,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+    # The script's machine-readable tail: the matrix must exercise the
+    # full breadth of orderings (round-3 verdict: >= 20 distinct), with
+    # nothing silently skipped on a complete matrix.
+    tail = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert tail["failures"] == 0
+    assert tail["checks"] >= 150, tail
+    assert tail["families"] >= 15, tail
+    assert tail["skipped"] == 0, proc.stdout
 
 
 @pytest.mark.slow
@@ -62,7 +71,7 @@ def test_committed_dmtt_ordering():
 @pytest.mark.slow
 def test_committed_matrix_is_complete():
     ok = _completed_records()
-    assert len(ok) >= 252, f"only {len(ok)} experiments ok"
+    assert len(ok) >= 300, f"only {len(ok)} experiments ok"
 
 
 def test_extras_robust_stats_orderings():

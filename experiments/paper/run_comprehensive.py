@@ -77,7 +77,37 @@ def run_one(cfg_path: Path, out_json: Path, timeout: float,
 def summarize(records: list) -> str:
     """RESULTS_SUMMARY.md: final accuracy per dataset x algorithm per
     category (reference: experiments/paper/RESULTS_SUMMARY.md)."""
-    lines = ["# Results summary", ""]
+    lines = [
+        "# Results summary",
+        "",
+        "## Reading these numbers (synthetic-regime expectations)",
+        "",
+        "This matrix runs on shape-identical **synthetic stand-ins** for the",
+        "wearable datasets (zero-egress environment), evaluated on per-node",
+        "holdouts from each node's own partition. Absolute accuracies are",
+        "therefore not comparable to the published tables; the orderings are",
+        "(asserted by `assert_orderings.py`, 15 families). Two places where",
+        "the synthetic regime *visibly changes* the picture, and why:",
+        "",
+        "- **Krum's clean-run accuracies (~0.16-0.31 on `fully`) are",
+        "  expected, not a defect.** Krum outputs a *single selected state*.",
+        "  Under strongly non-IID per-node label distributions with",
+        "  per-node evaluation, one neighbor's model cannot serve every",
+        "  node's personalized holdout, so the selected state scores low",
+        "  everywhere — and the more candidates there are (`fully`), the",
+        "  likelier the selection lands far from any given node (see the",
+        "  krum-connectivity-weakness ordering: krum/ring beats",
+        "  krum/fully). The published 38.8-54.5 % figures are on real data",
+        "  against a shared test distribution, which rewards any central",
+        "  state. The reference reports the same qualitative collapse",
+        "  (krum 46.8 vs fedavg 85.3 on UCI HAR).",
+        "- **The heterogeneity (alpha) direction flips.** Published Table II",
+        "  accuracy rises with alpha; here lower alpha = fewer classes per",
+        "  node = an *easier personalized* task under per-node holdouts, so",
+        "  robust-rule accuracy falls as alpha grows (asserted as the",
+        "  alpha-direction family).",
+        "",
+    ]
     by_cat = {}
     for r in records:
         if not r.get("ok"):

@@ -166,13 +166,23 @@ def main():
         compile_s = block()
         warmup_s = block()
         elapsed = block()
+        # Cost analysis runs here (AOT, nothing executes) so the network —
+        # and its resident [N, P] device state — can be dropped before the
+        # next variant builds; holding both variants' buffers would add
+        # HBM pressure during the second timed measurement.
+        flops = None
+        try:
+            cost = network.step_cost_analysis()
+            flops = float(cost.get("flops", 0.0)) or None
+        except Exception:
+            pass
         return {
-            "network": network,
             "param_dtype": param_dtype,
             "rounds_per_sec": timed_rounds / elapsed,
             "compile_s": round(compile_s, 2),
             "steady_warmup_s": round(warmup_s, 2),
             "elapsed": elapsed,
+            "flops": flops,
         }
 
     # Headline config (float32 resident params) plus — on the chip — the
@@ -180,24 +190,24 @@ def main():
     # setting: halves the [N, P] state and the SGD update's HBM traffic).
     # The better variant becomes the headline number, both are recorded.
     # The CPU fallback skips the lever (bf16 is emulated and slow there).
+    # A failure in the optional lever must not discard the already-measured
+    # headline (same attributable-fallback principle as the probe retries).
     variants = [measure("float32")]
+    lever_error = None
     if not on_cpu:
-        variants.append(measure("bfloat16"))
+        try:
+            variants.append(measure("bfloat16"))
+        except Exception as e:
+            lever_error = f"{type(e).__name__}: {e}"[:300]
     best = max(variants, key=lambda v: v["rounds_per_sec"])
     rounds_per_sec = best["rounds_per_sec"]
 
     # MFU: XLA's own flop count for the per-round train program (local SGD
     # + attack + exchange + Krum) vs peak chip flops.  Eval is a separate
     # program on the eval_every cadence and is excluded from round flops.
-    flops = mfu = None
-    try:
-        cost = best["network"].step_cost_analysis()
-        flops = float(cost.get("flops", 0.0)) or None
-        peak = _peak_flops(device_kind)
-        if flops and peak:
-            mfu = round(flops * rounds_per_sec / peak, 4)
-    except Exception:
-        pass
+    flops = best["flops"]
+    peak = _peak_flops(device_kind)
+    mfu = round(flops * rounds_per_sec / peak, 4) if flops and peak else None
 
     print(
         json.dumps(
@@ -222,6 +232,7 @@ def main():
                     v["param_dtype"]: round(v["rounds_per_sec"], 3)
                     for v in variants
                 },
+                "lever_error": lever_error,
                 "flops_per_round": flops,
                 "mfu": mfu,
             }

@@ -12,6 +12,7 @@ Prints one JSON line; run on the real TPU (uses marginal chain timing —
 the axon tunnel's block_until_ready does not block).
 """
 
+import argparse
 import json
 import time
 
@@ -36,7 +37,17 @@ def marginal_ms(f, args, k1=5, k2=25):
 def main():
     from murmura_tpu.models.cnn import make_femnist_cnn
 
-    n, b, steps = 20, 32, 4
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + short chains: correctness check of "
+                         "all four variants on CPU, not a measurement")
+    args = ap.parse_args()
+
+    n, b, steps = (2, 4, 2) if args.smoke else (20, 32, 4)
+    if args.smoke:
+        global marginal_ms
+        _full = marginal_ms
+        marginal_ms = lambda f, a: _full(f, a, k1=1, k2=2)
     model = make_femnist_cnn(num_classes=62, compute_dtype="bfloat16")
     keys = jax.random.split(jax.random.PRNGKey(0), n)
     params = jax.vmap(model.init)(keys)
@@ -176,6 +187,8 @@ def main():
 
     print(json.dumps({
         "device_kind": jax.devices()[0].device_kind,
+        "smoke": bool(args.smoke),
+        "shapes": {"nodes": n, "batch": b, "steps": steps},
         "vmapped_20node_4step_ms": round(t_vmap, 2),
         "fused_single_model_4step_ms": round(t_fused, 2),
         "vmapped_bf16_params_ms": round(t_bf16, 2),

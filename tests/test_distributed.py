@@ -272,3 +272,56 @@ class TestFaultInjection:
         earlier = accs[:-1][np.isfinite(accs[:-1])]
         if earlier.size:
             assert accs[-1] >= earlier.max() - 0.05, history
+
+
+class TestMonitorFlush:
+    """Unit-level Monitor semantics (no sockets): complete rounds flush in
+    order, partial rounds flush at the hard deadline with degradation
+    telemetry (reference: murmura/distributed/monitor.py:81-128)."""
+
+    def _monitor(self, nodes=3, rounds=3):
+        from murmura_tpu.distributed.monitor import Monitor
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "m", "seed": 0, "rounds": rounds},
+                "topology": {"type": "ring", "num_nodes": nodes},
+                "aggregation": {"algorithm": "fedavg"},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "distributed",
+                "distributed": {"transport": "ipc"},
+            }
+        )
+        return Monitor(cfg, "test", t_start=0.0)
+
+    def test_complete_then_partial_flush(self):
+        mon = self._monitor()
+        for node in range(3):  # round 0 fully reported
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                          "loss": 1.0})
+        for node in range(2):  # round 1 missing node 2 (crashed)
+            mon._ingest({"round": 1, "node": node, "accuracy": 0.8,
+                          "loss": 0.5})
+        mon._flush_complete()
+        assert mon.history["round"] == [1]
+        assert mon.history["reporting_nodes"] == [3]
+        mon._flush_partial()  # hard deadline passed
+        assert mon.history["round"] == [1, 2]
+        assert mon.history["reporting_nodes"] == [3, 2]
+        assert mon.history["mean_accuracy"][1] == pytest.approx(0.8)
+
+    def test_all_skipped_round_records_nan_row(self):
+        mon = self._monitor(nodes=2, rounds=1)
+        for node in range(2):  # every node overran its window
+            mon._ingest({"round": 0, "node": node, "skipped": True})
+        mon._flush_complete()
+        assert mon.history["round"] == [1]
+        assert mon.history["skipped_nodes"] == [2]
+        assert mon.history["reporting_nodes"] == [2]
+        assert np.isnan(mon.history["mean_accuracy"][0])

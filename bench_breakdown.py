@@ -21,12 +21,18 @@ Writes bench_breakdown.json (committed) and prints it.  Run on the real
 TPU (default env); the numbers anchor the MFU narrative in BENCH_r03.
 """
 
+import argparse
 import json
 import time
 from pathlib import Path
 
 import jax
 import jax.numpy as jnp
+
+# Set by --smoke: tiny shapes + short chains, print-only (the committed
+# bench_breakdown.json is a TPU artifact and must not be clobbered by a
+# CPU correctness check).
+SMOKE = False
 
 
 def _timed_step(step, args, k1=5, k2=45):
@@ -38,6 +44,8 @@ def _timed_step(step, args, k1=5, k2=45):
     params/agg_state forward, force one sync at the end, and report
     (t(k2) - t(k1)) / (k2 - k1) — the fixed latency cancels.
     """
+    if SMOKE:
+        k1, k2 = 1, 2
     params0, agg0, key, adj, comp, ridx, d = args
 
     def run(k):
@@ -57,6 +65,8 @@ def _timed_step(step, args, k1=5, k2=45):
 def _timed_eval(ev, params, d, k1=5, k2=45):
     """Marginal per-call device time of the eval sweep (same tunnel
     latency cancellation as _timed_step; calls serialize on the device)."""
+    if SMOKE:
+        k1, k2 = 1, 2
 
     def run(k):
         t0 = time.perf_counter()
@@ -120,7 +130,15 @@ def build(algo: str, local_epochs: int, raw_cfg=None):
     from murmura_tpu.data.registry import build_federated_data
     from murmura_tpu.utils.factories import build_attack, resolve_model
 
-    cfg = Config.model_validate(raw_cfg or FLAGSHIP_CFG)
+    raw = dict(raw_cfg or FLAGSHIP_CFG)
+    if SMOKE:
+        import copy
+
+        raw = copy.deepcopy(raw)
+        raw["data"]["params"]["num_samples"] = 16 * raw["topology"]["num_nodes"]
+        if "leaf" in raw["model"]["factory"].lower():
+            raw["model"]["params"] = {"variant": "tiny"}
+    cfg = Config.model_validate(raw)
     n = cfg.topology.num_nodes
     data = build_federated_data(
         cfg.data.adapter, cfg.data.params, num_nodes=n, seed=7
@@ -156,6 +174,13 @@ def build(algo: str, local_epochs: int, raw_cfg=None):
 
 def main():
     from murmura_tpu.topology.generators import create_topology
+
+    global SMOKE
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + short chains, print-only: "
+                         "correctness check of every segment program")
+    SMOKE = ap.parse_args().smoke
 
     results = {}
     adj = None
@@ -253,9 +278,12 @@ def main():
         "raw": results,
         "raw_probe": probe_results,
     }
-    Path(__file__).with_name("bench_breakdown.json").write_text(
-        json.dumps(blob, indent=2) + "\n"
-    )
+    if SMOKE:
+        blob["smoke"] = True
+    else:
+        Path(__file__).with_name("bench_breakdown.json").write_text(
+            json.dumps(blob, indent=2) + "\n"
+        )
     print(json.dumps(blob))
 
 

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Full TPU bench battery, run sequentially with per-step timeouts.
+# Usage: ./run_tpu_battery.sh [outdir]   (default /tmp/tpu_battery)
+# Each bench probes the backend itself and self-describes in its JSON;
+# bench_breakdown/bench_scaling write their committed artifacts only when
+# they actually ran (breakdown always writes; check "backend" in the JSON).
+set -u
+OUT="${1:-/tmp/tpu_battery}"
+mkdir -p "$OUT"
+cd "$(dirname "$0")"
+run() {
+  local name=$1 tmo=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  timeout "$tmo" "$@" > "$OUT/$name.out" 2>&1
+  local rc=$?
+  echo "$name rc=$rc" | tee -a "$OUT/battery.log"
+  tail -1 "$OUT/$name.out" >> "$OUT/battery.log"
+}
+run bench          2400 python bench.py
+run breakdown      2400 python bench_breakdown.py
+run sgd_micro      1800 python bench_sgd_micro.py
+run scaling        14400 python bench_scaling.py
+echo "battery done $(date)" | tee -a "$OUT/battery.log"

@@ -17,6 +17,7 @@ from murmura_tpu.aggregation.ubar import make_ubar
 from murmura_tpu.aggregation.evidential_trust import make_evidential_trust
 from murmura_tpu.aggregation.robust_stats import (
     make_coordinate_median,
+    make_geometric_median,
     make_trimmed_mean,
 )
 
@@ -30,6 +31,7 @@ AGGREGATORS = {
     # Beyond reference parity: the classic coordinate-wise robust rules.
     "median": make_coordinate_median,
     "trimmed_mean": make_trimmed_mean,
+    "geometric_median": make_geometric_median,
 }
 
 
@@ -72,6 +74,7 @@ __all__ = [
     "make_ubar",
     "make_evidential_trust",
     "make_coordinate_median",
+    "make_geometric_median",
     "make_trimmed_mean",
     "pairwise_l2_distances",
     "masked_neighbor_mean",

@@ -43,7 +43,7 @@ class AggregationConfig(_Strict):
     algorithm: Literal[
         "fedavg", "krum", "balance", "sketchguard", "ubar", "evidential_trust",
         # Beyond reference parity (coordinate-wise robust statistics):
-        "median", "trimmed_mean",
+        "median", "trimmed_mean", "geometric_median",
     ] = Field(description="Aggregation algorithm")
     params: Dict[str, Any] = Field(
         default_factory=dict, description="Algorithm-specific parameters"

@@ -212,15 +212,15 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
                 f"tpu.exchange: ppermute requires a circulant topology "
                 f"(ring/k-regular); '{config.topology.type}' is not"
             )
-        if config.aggregation.algorithm in ("median", "trimmed_mean"):
+        if config.aggregation.algorithm in ("median", "trimmed_mean", "geometric_median"):
             raise ConfigError(
                 f"tpu.exchange: ppermute has no circulant path for "
-                f"'{config.aggregation.algorithm}' (coordinate-wise sorts "
-                "need the gathered candidate tensor); use exchange: allgather"
+                f"'{config.aggregation.algorithm}' (these rules reduce over "
+                "the gathered candidate tensor); use exchange: allgather"
             )
         agg_params["exchange_offsets"] = offsets
     if (
-        config.aggregation.algorithm in ("krum", "median", "trimmed_mean")
+        config.aggregation.algorithm in ("krum", "median", "trimmed_mean", "geometric_median")
         and mobility is None
         and config.dmtt is None
     ):

@@ -15,7 +15,7 @@ on dense graphs m approaches N and the gather approaches the full
 cross-product.
 """
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax.numpy as jnp
 
@@ -101,6 +101,7 @@ def make_geometric_median(
     max_iters: int = 8,
     smoothing: float = 1e-6,
     max_candidates: Optional[int] = None,
+    exchange_offsets: Optional[Sequence[int]] = None,
     **_params,
 ) -> AggregatorDef:
     """Geometric median via smoothed Weiszfeld iterations (RFA,
@@ -115,6 +116,15 @@ def make_geometric_median(
     tensor, so the whole rule is O(max_iters · N·m·P), static control flow
     (``lax.fori_loop``), no data-dependent branches.
 
+    On circulant graphs (``tpu.exchange: ppermute``) the candidate gather
+    is replaced by k circular shifts of the broadcast tensor
+    (``aggregate_circulant`` below): same O(k·N·P) working set, but the
+    shifts lower to boundary collective-permutes on a sharded node axis —
+    O(degree) communication instead of the all-gather.  The coordinate-wise
+    rules above cannot do this (their per-coordinate sorts need the
+    materialized candidate axis ordering); the Weiszfeld recursion only
+    ever reduces over candidates, so it vectorizes over shifts directly.
+
     The smoothing floor on the distances is the standard Weiszfeld guard
     (a candidate exactly at the current iterate would otherwise get an
     infinite weight).
@@ -128,6 +138,9 @@ def make_geometric_median(
         # with the iterate yields inf/inf = NaN states.
         raise ValueError(f"smoothing must be > 0, got {smoothing}")
     mc = None if max_candidates is None else int(max_candidates)
+    offsets = (
+        None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    )
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         from jax import lax
@@ -173,4 +186,55 @@ def make_geometric_median(
         }
         return z.astype(own.dtype), state, stats
 
-    return AggregatorDef(name="geometric_median", aggregate=aggregate)
+    def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
+        """O(degree)-communication Weiszfeld for circulant graphs: node i's
+        candidates are itself plus the k fixed-offset neighbors, so the
+        candidate states are k rolled views of the broadcast tensor and
+        every reduction in the recursion is over the small static k axis."""
+        from jax import lax
+
+        n = own.shape[0]
+        k = len(offsets)
+        own32 = own.astype(jnp.float32)
+        rolled = jnp.stack(
+            [jnp.roll(bcast, -o, axis=0) for o in offsets]
+        ).astype(jnp.float32)  # [k, N, P]
+
+        def weighted_mean(w_self, w_k):
+            acc = w_self[:, None] * own32 + (w_k[:, :, None] * rolled).sum(0)
+            tot = w_self + w_k.sum(axis=0)
+            return acc / jnp.maximum(tot, 1e-30)[:, None]
+
+        def distances(z):
+            # f32 reduces, same rationale as the dense path.
+            d_self = jnp.sqrt(jnp.square(own32 - z).sum(axis=-1))  # [N]
+            d_k = jnp.sqrt(
+                jnp.square(rolled - z[None]).sum(axis=-1)
+            )  # [k, N]
+            return d_self, d_k
+
+        ones_k = jnp.ones((k, n), jnp.float32)
+        z0 = weighted_mean(jnp.ones((n,), jnp.float32), ones_k)
+
+        def body(_, z):
+            d_self, d_k = distances(z)
+            return weighted_mean(
+                1.0 / jnp.maximum(d_self, nu), 1.0 / jnp.maximum(d_k, nu)
+            )
+
+        z = lax.fori_loop(0, iters, body, z0)
+        d_self, d_k = distances(z)
+        w_self = 1.0 / jnp.maximum(d_self, nu)
+        w_k = 1.0 / jnp.maximum(d_k, nu)
+        tot = jnp.maximum(w_self + w_k.sum(axis=0), 1e-30)
+        stats = {
+            "num_candidates": jnp.full((n,), float(k + 1), jnp.float32),
+            "max_weight_share": jnp.maximum(w_self, w_k.max(axis=0)) / tot,
+            "mean_dist_to_gm": (d_self + d_k.sum(axis=0)) / float(k + 1),
+        }
+        return z.astype(own.dtype), state, stats
+
+    return AggregatorDef(
+        name="geometric_median",
+        aggregate=aggregate if offsets is None else aggregate_circulant,
+    )

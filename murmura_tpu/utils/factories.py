@@ -212,11 +212,12 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
                 f"tpu.exchange: ppermute requires a circulant topology "
                 f"(ring/k-regular); '{config.topology.type}' is not"
             )
-        if config.aggregation.algorithm in ("median", "trimmed_mean", "geometric_median"):
+        if config.aggregation.algorithm in ("median", "trimmed_mean"):
             raise ConfigError(
                 f"tpu.exchange: ppermute has no circulant path for "
-                f"'{config.aggregation.algorithm}' (these rules reduce over "
-                "the gathered candidate tensor); use exchange: allgather"
+                f"'{config.aggregation.algorithm}' (per-coordinate sorts "
+                "need the materialized candidate-axis ordering); use "
+                "exchange: allgather"
             )
         agg_params["exchange_offsets"] = offsets
     if (

@@ -616,3 +616,26 @@ class TestGeometricMedian:
 
         with pytest.raises(ValueError, match="smoothing"):
             build_aggregator("geometric_median", {"smoothing": 0.0})
+
+    def test_circulant_path_matches_dense_on_ring(self):
+        # tpu.exchange: ppermute serves geometric_median too: the rolled
+        # Weiszfeld recursion must agree with the dense candidate-tensor
+        # path on the same circulant graph.
+        rng = np.random.default_rng(8)
+        n = 8
+        own = rng.normal(size=(n, 6)).astype(np.float32)
+        bcast = own + rng.normal(size=(n, 6)).astype(np.float32) * 0.1
+        dense = build_aggregator("geometric_median", {"max_iters": 16})
+        circ = build_aggregator(
+            "geometric_median",
+            {"max_iters": 16, "exchange_offsets": [-1, 1]},
+        )
+        new_d, _, stats_d = _run(dense, own, _ring_adj(n), bcast=bcast)
+        new_c, _, stats_c = _run(circ, own, _ring_adj(n), bcast=bcast)
+        np.testing.assert_allclose(
+            np.asarray(new_d), np.asarray(new_c), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(stats_d["max_weight_share"]),
+            np.asarray(stats_c["max_weight_share"]), atol=1e-5,
+        )

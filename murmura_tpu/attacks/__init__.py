@@ -4,11 +4,13 @@ from murmura_tpu.attacks.base import Attack, select_compromised
 from murmura_tpu.attacks.gaussian import make_gaussian_attack
 from murmura_tpu.attacks.directed import make_directed_deviation_attack
 from murmura_tpu.attacks.topology_liar import make_topology_liar_attack, false_claims
+from murmura_tpu.attacks.alie import make_alie_attack
 
 ATTACKS = {
     "gaussian": make_gaussian_attack,
     "directed_deviation": make_directed_deviation_attack,
     "topology_liar": make_topology_liar_attack,
+    "alie": make_alie_attack,
 }
 
 __all__ = [
@@ -17,6 +19,7 @@ __all__ = [
     "make_gaussian_attack",
     "make_directed_deviation_attack",
     "make_topology_liar_attack",
+    "make_alie_attack",
     "false_claims",
     "ATTACKS",
 ]

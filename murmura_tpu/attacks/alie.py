@@ -1,0 +1,81 @@
+"""ALIE — "A Little Is Enough" colluding attack (Baruch et al.,
+NeurIPS 2019).  No reference counterpart (murmura ships gaussian /
+directed_deviation / topology_liar); included beyond parity because it is
+the canonical *stealth* Byzantine attack the robust-aggregation literature
+evaluates against: instead of shouting (large noise / sign flips), the
+colluding nodes all broadcast the same vector
+
+    mu_honest - z * sigma_honest        (coordinate-wise)
+
+placed just inside the benign variance envelope, where distance- and
+score-based defenses cannot distinguish it from an honest straggler.  The
+deviation factor z is chosen from the normal quantile so that the
+malicious value is closer to the honest mean than the furthest
+``s = floor(n/2) + 1 - m`` honest nodes are expected to be (the paper's
+z_max rule), or can be overridden via ``params: {z: ...}``.
+
+This is a *colluding* attack: computing mu/sigma over the honest rows
+needs the full-network view, which the jitted round step has (the whole
+``[N, P]`` broadcast tensor).  The per-process ZMQ backend has no such
+view, so the factory rejects ``backend: distributed`` with a readable
+ConfigError rather than silently running a weaker attack.
+"""
+
+from statistics import NormalDist
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from murmura_tpu.attacks.base import Attack, select_compromised
+
+
+def alie_z_max(num_nodes: int, num_compromised: int) -> float:
+    """The paper's z_max: the largest z with
+    phi(z) <= (n - m - s) / (n - m), s = floor(n/2) + 1 - m.
+
+    For m >= the majority (s <= 0) the quantile saturates; the clamp keeps
+    the construction defined (the attack is trivially unstoppable there).
+    """
+    n, m = int(num_nodes), int(num_compromised)
+    honest = max(n - m, 1)
+    s = n // 2 + 1 - m
+    cdf = (honest - s) / honest
+    cdf = min(max(cdf, 1e-9), 1.0 - 1e-9)
+    return float(NormalDist().inv_cdf(cdf))
+
+
+def make_alie_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    z: Optional[float] = None,
+    seed: int = 42,
+) -> Attack:
+    compromised = select_compromised(num_nodes, attack_percentage, seed)
+    comp_idx = np.flatnonzero(compromised)
+    z_val = (
+        float(z) if z is not None else alie_z_max(num_nodes, len(comp_idx))
+    )
+
+    def apply(flat, compromised_mask, key, round_idx):
+        if flat.shape[0] != num_nodes or not len(comp_idx):
+            # Per-node view (ZMQ backend): no honest-population statistics
+            # exist here — the factory rejects that wiring at build time,
+            # so this is only reachable from direct library use; pass
+            # through rather than fabricate a non-colluding variant.
+            return flat
+        # Honest-population coordinate statistics in f32 (a bf16 variance
+        # over N rows would quantize the small sigmas the stealth margin
+        # depends on).
+        f32 = flat.astype(jnp.float32)
+        hm = (1.0 - compromised_mask.astype(jnp.float32))[:, None]  # [N, 1]
+        cnt = jnp.maximum(hm.sum(), 1.0)
+        mu = (f32 * hm).sum(axis=0, keepdims=True) / cnt
+        var = (jnp.square(f32 - mu) * hm).sum(axis=0, keepdims=True) / cnt
+        malicious = (mu - z_val * jnp.sqrt(var)).astype(flat.dtype)  # [1, P]
+        # Elementwise select, not scatter (same layout rationale as the
+        # gaussian attack's one-hot rewrite): every compromised row
+        # broadcasts the identical colluding vector.
+        return jnp.where(compromised_mask[:, None] > 0, malicious, flat)
+
+    return Attack(name="alie", compromised=compromised, apply=apply)

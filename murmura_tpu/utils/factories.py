@@ -47,6 +47,19 @@ def build_attack(config: Config) -> Optional[Attack]:
             lambda_param=float(p.get("lambda_param", -5.0)),
             seed=seed,
         )
+    if config.attack.type == "alie":
+        if config.backend == "distributed":
+            raise ConfigError(
+                "attack type 'alie' is a colluding attack needing the "
+                "full-network view; the per-process distributed backend "
+                "cannot provide it — use backend: simulation or tpu"
+            )
+        return ATTACKS["alie"](
+            num_nodes=n,
+            attack_percentage=pct,
+            z=p.get("z"),
+            seed=seed,
+        )
     if config.attack.type == "topology_liar":
         inner = None
         inner_type = p.get("model_attack_type")
@@ -63,6 +76,18 @@ def build_attack(config: Config) -> Optional[Attack]:
                 attack_percentage=pct,
                 lambda_param=float(p.get("lambda_param", -5.0)),
                 seed=seed,
+            )
+        elif inner_type is not None:
+            # Fail loud: a typo'd or unsupported inner attack must not
+            # silently degrade to topology-lies-only (the experiment would
+            # measure the wrong threat model).  'alie' is deliberately not
+            # wired here: DMTT liars already coordinate through claims, and
+            # the colluding model vector would need the full-network view
+            # inside the per-claim transform.
+            raise ConfigError(
+                f"topology_liar model_attack_type '{inner_type}' is not "
+                "supported; use 'gaussian' or 'directed_deviation' (or omit "
+                "for topology lies only)"
             )
         return ATTACKS["topology_liar"](
             num_nodes=n, attack_percentage=pct, seed=seed, model_attack=inner

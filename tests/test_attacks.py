@@ -103,3 +103,123 @@ class TestAttackProtocol:
         assert len(nodes) == 2
         for i in range(10):
             assert atk.is_compromised(i) == (i in nodes)
+
+
+class TestALIE:
+    """Beyond-parity colluding attack (alie.py; Baruch et al. 2019)."""
+
+    def test_compromised_rows_collude_at_mu_minus_z_sigma(self):
+        from murmura_tpu.attacks.alie import make_alie_attack
+
+        atk = make_alie_attack(10, 0.2, z=1.5, seed=42)
+        rng = np.random.default_rng(0)
+        flat = jnp.asarray(rng.normal(size=(10, 32)).astype(np.float32))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        out = np.asarray(atk.apply(flat, comp, jax.random.PRNGKey(0), 0))
+
+        honest = ~atk.compromised
+        # Honest rows pass through untouched.
+        np.testing.assert_array_equal(out[honest], np.asarray(flat)[honest])
+        # Compromised rows all broadcast the identical colluding vector.
+        comp_rows = out[atk.compromised]
+        np.testing.assert_array_equal(comp_rows[0], comp_rows[1])
+        # ... equal to mu - z*sigma of the HONEST population.
+        mu = np.asarray(flat)[honest].mean(axis=0)
+        sigma = np.asarray(flat)[honest].std(axis=0)
+        np.testing.assert_allclose(comp_rows[0], mu - 1.5 * sigma, atol=1e-5)
+
+    def test_z_max_grows_with_coalition_size(self):
+        from murmura_tpu.attacks.alie import alie_z_max
+
+        zs = [alie_z_max(20, m) for m in (2, 6, 8)]
+        assert zs[0] <= zs[1] <= zs[2], zs
+        assert zs[2] < 3.0  # stays a *little* deviation
+
+    def test_distributed_backend_rejected(self):
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import ConfigError, build_attack
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "a", "seed": 0, "rounds": 1},
+                "topology": {"type": "ring", "num_nodes": 4},
+                "aggregation": {"algorithm": "fedavg"},
+                "attack": {"enabled": True, "type": "alie",
+                            "percentage": 0.25},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "distributed",
+                "distributed": {"transport": "ipc"},
+            }
+        )
+        with pytest.raises(ConfigError, match="colluding"):
+            build_attack(cfg)
+
+    def test_network_runs_and_biases_fedavg(self):
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        base = {
+            "experiment": {"name": "alie", "seed": 3, "rounds": 3},
+            "topology": {"type": "fully", "num_nodes": 8},
+            "aggregation": {"algorithm": "fedavg"},
+            "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.1},
+            "data": {"adapter": "synthetic",
+                      "params": {"num_samples": 640, "input_dim": 24,
+                                 "num_classes": 4}},
+            "model": {"factory": "mlp",
+                       "params": {"input_dim": 24, "hidden_dims": [32],
+                                  "num_classes": 4}},
+            "backend": "simulation",
+            "tpu": {"compute_dtype": "float32"},
+        }
+        clean = build_network_from_config(
+            Config.model_validate(base)
+        ).train(rounds=3)
+        attacked_cfg = {**base,
+                         "attack": {"enabled": True, "type": "alie",
+                                    "percentage": 0.375,
+                                    "params": {"z": 3.0}}}
+        attacked = build_network_from_config(
+            Config.model_validate(attacked_cfg)
+        ).train(rounds=3)
+        assert np.isfinite(attacked["honest_accuracy"]).all()
+        # A strong colluding deviation (z=3, 3/8 nodes) must cost fedavg
+        # accuracy while training is still in flight (round 1).  By
+        # design ALIE fades as honest nodes converge — sigma_honest
+        # shrinks, so the colluding vector collapses toward mu and the
+        # trivially-separable synthetic task still saturates; the
+        # pre-saturation round is where the bias is observable.
+        assert (
+            attacked["honest_accuracy"][0] < clean["mean_accuracy"][0] - 0.05
+        ), (attacked["honest_accuracy"], clean["mean_accuracy"])
+
+    def test_topology_liar_rejects_unknown_inner_attack(self):
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import ConfigError, build_attack
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "t", "seed": 0, "rounds": 1},
+                "topology": {"type": "ring", "num_nodes": 4},
+                "aggregation": {"algorithm": "fedavg"},
+                "attack": {"enabled": True, "type": "topology_liar",
+                            "percentage": 0.25,
+                            "params": {"model_attack_type": "alie"}},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "simulation",
+            }
+        )
+        with pytest.raises(ConfigError, match="model_attack_type"):
+            build_attack(cfg)

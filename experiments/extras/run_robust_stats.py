@@ -36,6 +36,12 @@ BASE = {
 ATTACK = {"enabled": True, "type": "gaussian", "percentage": 0.2,
           "params": {"noise_std": 10.0}}
 
+# The stealth scenario: ALIE hides inside the honest variance envelope
+# (alie.py).  z is explicit because the paper's z_max rule degenerates to
+# 0 at n=10/m=2 (the quantile construction targets larger coalitions).
+ALIE_ATTACK = {"enabled": True, "type": "alie", "percentage": 0.2,
+               "params": {"z": 1.5}}
+
 RULES = {
     "fedavg": {},
     "median": {},
@@ -82,6 +88,20 @@ def main():
             print(f"[{tag}] ...", file=sys.stderr, flush=True)
             results[tag] = run_cfg(cfg, tag)
 
+    # ALIE evidence: the colluding stealth attack vs plain averaging and
+    # the strongest beyond-parity rule.  (The coordinate-wise rules are
+    # omitted: ALIE is designed to sit inside the per-coordinate envelope
+    # they filter on, and their clean accuracy on this non-IID task is
+    # already the limiting factor.)
+    for rule in ("fedavg", "geometric_median"):
+        tag = f"{rule}_alie"
+        cfg = json.loads(json.dumps(BASE))
+        cfg["aggregation"] = {"algorithm": rule,
+                               "params": RULES.get(rule, {})}
+        cfg["attack"] = ALIE_ATTACK
+        print(f"[{tag}] ...", file=sys.stderr, flush=True)
+        results[tag] = run_cfg(cfg, tag)
+
     checks = {
         "fedavg_collapses": (
             results["fedavg_attacked"]["final_accuracy"]
@@ -101,6 +121,19 @@ def main():
         checks[f"{rule}_beats_attacked_fedavg"] = (
             att >= results["fedavg_attacked"]["final_accuracy"] + 0.15
         )
+
+    checks["alie_degrades_fedavg"] = (
+        results["fedavg_alie"]["final_accuracy"]
+        < results["fedavg_clean"]["final_accuracy"] - 0.15
+    )
+    checks["geometric_median_holds_under_alie"] = (
+        results["geometric_median_alie"]["final_accuracy"]
+        >= results["geometric_median_clean"]["final_accuracy"] - 0.25
+    )
+    checks["geometric_median_beats_fedavg_under_alie"] = (
+        results["geometric_median_alie"]["final_accuracy"]
+        >= results["fedavg_alie"]["final_accuracy"] + 0.03
+    )
 
     blob = {"results": results, "checks": checks, "all_pass": all(checks.values())}
     (HERE / "results.json").write_text(json.dumps(blob, indent=2) + "\n")

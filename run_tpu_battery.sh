@@ -1,11 +1,15 @@
 #!/bin/bash
 # Full TPU bench battery, run sequentially with per-step timeouts.
-# Usage: ./run_tpu_battery.sh [outdir]   (default /tmp/tpu_battery)
+# Usage: ./run_tpu_battery.sh [outdir]  (default: tpu_battery_results/ in
+# the repo, so results survive into the driver's end-of-round commit even
+# if the tunnel recovers after the working window; bench_breakdown.json
+# and bench_scaling.json are additionally rewritten at the repo root by
+# their own scripts)
 # Each bench probes the backend itself and self-describes in its JSON;
 # bench_breakdown/bench_scaling write their committed artifacts only when
 # they actually ran (breakdown always writes; check "backend" in the JSON).
 set -u
-OUT="${1:-/tmp/tpu_battery}"
+OUT="${1:-/root/repo/tpu_battery_results}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")"
 run() {

@@ -153,3 +153,19 @@ def test_fused_round_times_are_per_round_and_defer_metrics_warns():
                   defer_metrics=True)
     assert len(net.round_times) == 6
     assert any("defer_metrics is ignored" in str(w.message) for w in caught)
+
+
+def test_fused_alie_attack_matches_per_round():
+    # The colluding attack computes honest-population statistics from the
+    # full broadcast tensor inside the traced step; the lax.scan carry
+    # must reproduce the per-round dispatch exactly.
+    extra = {
+        "topology": {"type": "fully", "num_nodes": 8},
+        "attack": {"enabled": True, "type": "alie", "percentage": 0.25,
+                    "params": {"z": 2.0}},
+    }
+    base = build_network_from_config(_cfg(**extra)).train(rounds=4, eval_every=2)
+    fused = build_network_from_config(_cfg(**extra)).train(
+        rounds=4, eval_every=2, rounds_per_dispatch=2
+    )
+    _assert_history_close(base, fused)

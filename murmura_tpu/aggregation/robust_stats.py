@@ -39,10 +39,23 @@ def _candidate_tensor(own, bcast, adj, m_cap):
 
 
 def make_coordinate_median(
-    max_candidates: Optional[int] = None, **_params
+    max_candidates: Optional[int] = None,
+    exchange_offsets: Optional[Sequence[int]] = None,
+    **_params,
 ) -> AggregatorDef:
-    """Coordinate-wise median over own + neighbor states."""
+    """Coordinate-wise median over own + neighbor states.
+
+    On circulant graphs (``tpu.exchange: ppermute``) the gather is replaced
+    by k circular shifts stacked into a [k+1, N, P] candidate tensor with
+    the sort over the small static leading axis — the same O(k·N·P) working
+    set as the gathered path (every candidate is valid on a circulant
+    graph, so no inf-padding is needed) and the same O(degree)
+    boundary-ppermute communication win the other rules get.
+    """
     mc = None if max_candidates is None else int(max_candidates)
+    offsets = (
+        None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    )
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
@@ -60,20 +73,45 @@ def make_coordinate_median(
         new_flat = (0.5 * (lo + hi))[:, 0, :]
         return new_flat, state, {"num_candidates": cnt.astype(jnp.float32)}
 
-    return AggregatorDef(name="median", aggregate=aggregate)
+    def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
+        n = own.shape[0]
+        m = len(offsets) + 1
+        cand = jnp.stack(
+            [own] + [jnp.roll(bcast, -o, axis=0) for o in offsets]
+        )  # [m, N, P], all valid
+        ranked = jnp.sort(cand, axis=0)
+        new_flat = 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
+        return new_flat, state, {
+            "num_candidates": jnp.full((n,), float(m), jnp.float32)
+        }
+
+    return AggregatorDef(
+        name="median",
+        aggregate=aggregate if offsets is None else aggregate_circulant,
+    )
 
 
 def make_trimmed_mean(
     trim_ratio: float = 0.2,
     max_candidates: Optional[int] = None,
+    exchange_offsets: Optional[Sequence[int]] = None,
     **_params,
 ) -> AggregatorDef:
     """Coordinate-wise beta-trimmed mean: drop the floor(beta*cnt) smallest
-    and largest values per coordinate, average the rest."""
+    and largest values per coordinate, average the rest.
+
+    The circulant path (``exchange_offsets``) mirrors the median's: with a
+    constant candidate count m = k+1 the trim depth is static, so the keep
+    window is a static slice of the sorted [m, N, P] stack rather than a
+    masked sum.
+    """
     beta = float(trim_ratio)
     if not 0.0 <= beta < 0.5:
         raise ValueError(f"trim_ratio must be in [0, 0.5), got {beta}")
     mc = None if max_candidates is None else int(max_candidates)
+    offsets = (
+        None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    )
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
@@ -94,7 +132,24 @@ def make_trimmed_mean(
             "trimmed_per_side": trim.astype(jnp.float32),
         }
 
-    return AggregatorDef(name="trimmed_mean", aggregate=aggregate)
+    def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
+        n = own.shape[0]
+        m = len(offsets) + 1
+        trim = int(beta * m)  # static: every node has exactly m candidates
+        cand = jnp.stack(
+            [own] + [jnp.roll(bcast, -o, axis=0) for o in offsets]
+        )  # [m, N, P]
+        ranked = jnp.sort(cand, axis=0)
+        new_flat = ranked[trim : m - trim].mean(axis=0)  # m-2*trim >= 1
+        return new_flat, state, {
+            "num_candidates": jnp.full((n,), float(m), jnp.float32),
+            "trimmed_per_side": jnp.full((n,), float(trim), jnp.float32),
+        }
+
+    return AggregatorDef(
+        name="trimmed_mean",
+        aggregate=aggregate if offsets is None else aggregate_circulant,
+    )
 
 
 def make_geometric_median(
@@ -121,9 +176,8 @@ def make_geometric_median(
     (``aggregate_circulant`` below): same O(k·N·P) working set, but the
     shifts lower to boundary collective-permutes on a sharded node axis —
     O(degree) communication instead of the all-gather.  The coordinate-wise
-    rules above cannot do this (their per-coordinate sorts need the
-    materialized candidate axis ordering); the Weiszfeld recursion only
-    ever reduces over candidates, so it vectorizes over shifts directly.
+    rules above get the same treatment by stacking the shifts into a
+    [k+1, N, P] candidate tensor and sorting over the static leading axis.
 
     The smoothing floor on the distances is the standard Weiszfeld guard
     (a candidate exactly at the current iterate would otherwise get an

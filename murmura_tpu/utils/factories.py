@@ -48,12 +48,38 @@ def build_attack(config: Config) -> Optional[Attack]:
             seed=seed,
         )
     if config.attack.type == "alie":
+        # On simulation/tpu the jitted round step computes the colluding
+        # vector from the TRUE honest rows (omniscient variant — stronger
+        # than the paper's construction; alie.py docstring).  On the ZMQ
+        # backend each colluding NodeProcess instead estimates mu/sigma
+        # from the coalition's own benign states (the paper's estimator) —
+        # see NodeProcess._alie_colluding_state.
         if config.backend == "distributed":
-            raise ConfigError(
-                "attack type 'alie' is a colluding attack needing the "
-                "full-network view; the per-process distributed backend "
-                "cannot provide it — use backend: simulation or tpu"
-            )
+            if config.dmtt is not None:
+                # DMTTNodeProcess overrides _execute_round without the
+                # coalition branch; letting alie fall through to the
+                # per-node apply() would silently run NO attack while the
+                # experiment reports "under ALIE" — fail loud instead.
+                raise ConfigError(
+                    "attack type 'alie' is not wired into the DMTT "
+                    "distributed round protocol; use backend: "
+                    "simulation/tpu for alie+dmtt, or a different attack "
+                    "on the distributed backend"
+                )
+            from murmura_tpu.attacks.base import select_compromised
+
+            if select_compromised(n, pct, seed).sum() < 2:
+                # The ZMQ coalition estimator needs >= 2 colluders: with
+                # one, sigma over the coalition sample is 0 and mu - z*s
+                # degenerates to the colluder's benign state — a silent
+                # no-attack run labeled "under ALIE" (the sim/tpu
+                # omniscient variant has no such minimum).
+                raise ConfigError(
+                    "attack type 'alie' on backend: distributed needs at "
+                    "least 2 compromised nodes (the coalition mu/sigma "
+                    "estimator is degenerate with 1); raise "
+                    "attack.percentage or use backend: simulation/tpu"
+                )
         return ATTACKS["alie"](
             num_nodes=n,
             attack_percentage=pct,
@@ -117,6 +143,18 @@ class ConfigError(ValueError):
     tracebacks; unexpected ValueErrors stay loud."""
 
 
+def resolved_param_dtype(config: Config) -> Optional[str]:
+    """tpu.param_dtype with the documented large-N auto default: bfloat16
+    from 64 nodes up (halves the [N, P] resident state and the SGD
+    update's HBM traffic — the bench_sgd_micro lever; bench.py's 256-node
+    north-star runs it), float32 below, explicit setting always wins."""
+    if config.backend != "tpu":
+        return None
+    if config.tpu.param_dtype is not None:
+        return config.tpu.param_dtype
+    return "bfloat16" if config.topology.num_nodes >= 64 else "float32"
+
+
 def resolve_model(config: Config, data):
     """Build the model for a config with data-aware parameter sync and a
     fail-fast shape check.
@@ -131,6 +169,12 @@ def resolve_model(config: Config, data):
         # MXU mixed precision: bfloat16 matmul/conv inputs, float32 params
         # and accumulation (tpu.compute_dtype, default bfloat16).
         model_params.setdefault("compute_dtype", config.tpu.compute_dtype)
+        if config.tpu.conv_impl != "direct" and (
+            "femnist" in config.model.factory
+            or "celeba" in config.model.factory
+        ):
+            # CNN-only lever; non-conv models have no im2col formulation.
+            model_params.setdefault("conv_impl", config.tpu.conv_impl)
     if (
         "wearables." in config.model.factory
         and "input_dim" not in model_params
@@ -237,13 +281,6 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
                 f"tpu.exchange: ppermute requires a circulant topology "
                 f"(ring/k-regular); '{config.topology.type}' is not"
             )
-        if config.aggregation.algorithm in ("median", "trimmed_mean"):
-            raise ConfigError(
-                f"tpu.exchange: ppermute has no circulant path for "
-                f"'{config.aggregation.algorithm}' (per-coordinate sorts "
-                "need the materialized candidate-axis ordering); use "
-                "exchange: allgather"
-            )
         agg_params["exchange_offsets"] = offsets
     if (
         config.aggregation.algorithm in ("krum", "median", "trimmed_mean", "geometric_median")
@@ -297,7 +334,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         annealing_rounds=max(1, rounds // 2),
         lambda_weight=0.1,
         dmtt=dmtt,
-        param_dtype=config.tpu.param_dtype if config.backend == "tpu" else None,
+        param_dtype=resolved_param_dtype(config),
     )
 
     if config.backend == "tpu" and mesh is None:

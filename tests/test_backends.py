@@ -144,6 +144,8 @@ import pytest
     ("sketchguard", {"sketch_size": 64}),
     ("ubar", {"rho": 0.6}),
     ("evidential_trust", {"trust_threshold": 0.1}),
+    ("median", {}),
+    ("trimmed_mean", {"trim_ratio": 0.2}),
 ])
 def test_ppermute_circulant_rule_matches_allgather(algo, params):
     def cfg(exchange):

@@ -14,11 +14,22 @@ malicious value is closer to the honest mean than the furthest
 ``s = floor(n/2) + 1 - m`` honest nodes are expected to be (the paper's
 z_max rule), or can be overridden via ``params: {z: ...}``.
 
-This is a *colluding* attack: computing mu/sigma over the honest rows
-needs the full-network view, which the jitted round step has (the whole
-``[N, P]`` broadcast tensor).  The per-process ZMQ backend has no such
-view, so the factory rejects ``backend: distributed`` with a readable
-ConfigError rather than silently running a weaker attack.
+This is a *colluding* attack, and the two backends realize the collusion
+differently:
+
+- simulation/tpu (the jitted round step): mu/sigma are computed over the
+  TRUE honest rows of the ``[N, P]`` broadcast tensor.  This is the
+  *omniscient* variant — strictly STRONGER than the paper's construction
+  (Baruch et al. estimate the population statistics from the m corrupted
+  workers' own benign gradients).  Results labeled "ALIE" from these
+  backends should carry that caveat (see experiments/extras and
+  RESULTS_SUMMARY).
+- distributed (ZMQ): no process sees the honest population, so each
+  colluder broadcasts its benign locally-trained state to the coalition
+  (``MsgType.COLLUDE_STATE`` — attackers coordinate out-of-band by
+  construction) and estimates mu/sigma from the coalition sample.  This
+  IS the paper's estimator; see
+  ``NodeProcess._alie_colluding_state``/``colluding_vector`` below.
 """
 
 from statistics import NormalDist
@@ -45,6 +56,31 @@ def alie_z_max(num_nodes: int, num_compromised: int) -> float:
     return float(NormalDist().inv_cdf(cdf))
 
 
+def resolve_alie_z(
+    num_nodes: int, num_compromised: int, z: Optional[float] = None
+) -> float:
+    """Single z-resolution rule shared by the jitted attack
+    (make_alie_attack) and the ZMQ coalition path
+    (NodeProcess._alie_colluding_state): explicit override wins, else the
+    paper's z_max."""
+    return float(z) if z is not None else alie_z_max(num_nodes, num_compromised)
+
+
+def colluding_vector(benign_states: np.ndarray, z: float) -> np.ndarray:
+    """The paper's malicious vector from a coalition sample: mu - z*sigma
+    over the colluders' own benign states ([M, P], M >= 1).
+
+    Statistics accumulate in f64 on the host (this runs in the ZMQ
+    NodeProcess, outside jit) and return f32 — the wire dtype.  With a
+    single colluder sigma is 0 and the vector degenerates to the benign
+    state (the paper's construction needs M >= 2 to estimate variance).
+    """
+    s = np.asarray(benign_states, dtype=np.float64)
+    mu = s.mean(axis=0)
+    sigma = s.std(axis=0)
+    return (mu - float(z) * sigma).astype(np.float32)
+
+
 def make_alie_attack(
     num_nodes: int,
     attack_percentage: float,
@@ -53,15 +89,16 @@ def make_alie_attack(
 ) -> Attack:
     compromised = select_compromised(num_nodes, attack_percentage, seed)
     comp_idx = np.flatnonzero(compromised)
-    z_val = (
-        float(z) if z is not None else alie_z_max(num_nodes, len(comp_idx))
-    )
+    z_val = resolve_alie_z(num_nodes, len(comp_idx), z)
 
     def apply(flat, compromised_mask, key, round_idx):
         if flat.shape[0] != num_nodes or not len(comp_idx):
-            # Per-node view (ZMQ backend): no honest-population statistics
-            # exist here — the factory rejects that wiring at build time,
-            # so this is only reachable from direct library use; pass
+            # Per-node view: no honest-population statistics exist here.
+            # The ZMQ backend never routes ALIE through this function —
+            # NodeProcess._execute_round branches to the coalition
+            # estimator (_alie_colluding_state) instead, and the factory
+            # rejects the one distributed path without that branch
+            # (alie+dmtt).  Reachable only from direct library use; pass
             # through rather than fabricate a non-colluding variant.
             return flat
         # Honest-population coordinate statistics in f32 (a bf16 variance

@@ -25,6 +25,9 @@ class MsgType(IntEnum):
     MODEL_STATE = 1
     METRICS = 2
     TOPO_CLAIM = 3
+    # Intra-coalition benign-state exchange for the ALIE colluding attack
+    # (attackers coordinate out-of-band by construction — Baruch et al.).
+    COLLUDE_STATE = 4
 
 
 def pack_state(flat: np.ndarray) -> bytes:

@@ -227,13 +227,24 @@ class NodeProcess:
                 time.sleep(delay)
             self._execute_round(k)
 
+    @property
+    def _is_alie_colluder(self) -> bool:
+        return (
+            self.attack is not None
+            and self.attack.name == "alie"
+            and self.is_compromised
+        )
+
     def _execute_round(self, round_idx: int) -> None:
         """One wall-clock round (reference: node_process.py:193-247)."""
         deadline = self.t_start + (round_idx + 1) * self.round_duration
         neighbors = self.current_neighbors(round_idx)
 
-        # 1. local training (honest only — node_process.py:205-207)
-        if not self.is_compromised:
+        # 1. local training (honest only — node_process.py:205-207).
+        # ALIE colluders ALSO train: their benign states are the coalition
+        # sample the paper's mu/sigma estimator runs on (alie.py module
+        # docstring); the benign result never leaves the coalition.
+        if not self.is_compromised or self._is_alie_colluder:
             self.node.local_train(round_idx)
 
         # 2. overrun check: skip exchange if training blew the window
@@ -247,9 +258,18 @@ class NodeProcess:
             self._send_metrics(round_idx, skipped=True)
             return
 
-        # 3. attack own outgoing state (node_process.py:221-225)
+        # 3. attack own outgoing state (node_process.py:221-225).  ALIE
+        # colluders first exchange benign states within the coalition;
+        # neighbor MODEL_STATEs arriving during that window are buffered
+        # and handed to the collection in step 5.
         flat = self.node.get_flat_state()
-        out_flat = self._attacked_state(flat, round_idx)
+        prebuffered: Dict[int, np.ndarray] = {}
+        if self._is_alie_colluder:
+            out_flat, prebuffered = self._alie_colluding_state(
+                flat, round_idx, deadline
+            )
+        else:
+            out_flat = self._attacked_state(flat, round_idx)
 
         # 4. PUSH to current neighbors (node_process.py:227-232)
         payload = pack_state(out_flat)
@@ -264,7 +284,9 @@ class NodeProcess:
 
         # 5. collect neighbor states until expected or deadline
         # (node_process.py:249-276)
-        received = self._collect_states(set(neighbors), round_idx, deadline)
+        received = self._collect_states(
+            set(neighbors), round_idx, deadline, prebuffered=prebuffered
+        )
 
         # 6. aggregate with whatever arrived (partial OK)
         if received:
@@ -288,12 +310,86 @@ class NodeProcess:
         )
         return np.asarray(out[0], dtype=np.float32)
 
+    def _alie_colluding_state(
+        self, flat: np.ndarray, round_idx: int, deadline: float
+    ) -> tuple:
+        """Coalition-estimated ALIE vector (the paper's construction —
+        Baruch et al. estimate population mu/sigma from the corrupted
+        workers' own benign gradients; module docstring of attacks/alie.py
+        has the omniscient-vs-estimated distinction).
+
+        Protocol: push own benign state to every other colluder
+        (COLLUDE_STATE), collect theirs until half the remaining round
+        window is spent, then broadcast mu - z*sigma over whatever
+        coalition sample arrived (always >= the own state — the same
+        partial-collect degradation the model exchange uses).  Neighbor
+        MODEL_STATEs arriving early are buffered and returned for step 5.
+        """
+        import zmq
+
+        from murmura_tpu.attacks.alie import colluding_vector, resolve_alie_z
+
+        z = resolve_alie_z(
+            self.config.topology.num_nodes,
+            len(self.compromised_ids),
+            self.config.attack.params.get("z"),
+        )
+        peers = sorted(self.compromised_ids - {self.node_id})
+        payload = pack_state(flat)
+        for nid in peers:
+            try:
+                self._push_to(nid).send_multipart(
+                    encode(MsgType.COLLUDE_STATE, self.node_id, payload, round_idx),
+                    copy=False,
+                )
+            except Exception as e:  # pragma: no cover - socket teardown races
+                print(
+                    f"[node {self.node_id}] collude push to {nid} failed: {e}",
+                    flush=True,
+                )
+
+        coalition: Dict[int, np.ndarray] = {self.node_id: np.asarray(flat)}
+        prebuffered: Dict[int, np.ndarray] = {}
+        # Leave at least half the remaining window for the real exchange.
+        sub_deadline = min(
+            deadline, time.monotonic() + 0.5 * max(0.0, deadline - time.monotonic())
+        )
+        poller = zmq.Poller()
+        poller.register(self._pull, zmq.POLLIN)
+        while set(peers) - set(coalition) and time.monotonic() < sub_deadline:
+            timeout_ms = max(1, int((sub_deadline - time.monotonic()) * 1000))
+            events = dict(poller.poll(min(timeout_ms, 200)))
+            if self._pull not in events:
+                continue
+            msg_type, sender, msg_round, data = decode(self._pull.recv_multipart())
+            if msg_round != round_idx:
+                continue  # straggler from an earlier round window
+            if msg_type == MsgType.COLLUDE_STATE and sender in peers:
+                coalition[sender] = unpack_state(data)
+            elif msg_type == MsgType.MODEL_STATE:
+                prebuffered[sender] = unpack_state(data)
+        missing = set(peers) - set(coalition)
+        if missing:
+            print(
+                f"[node {self.node_id}] alie: coalition sample "
+                f"{len(coalition)}/{len(peers) + 1} (missing {sorted(missing)})",
+                flush=True,
+            )
+        out = colluding_vector(np.stack(list(coalition.values())), z)
+        return out, prebuffered
+
     def _collect_states(
-        self, expected: set, round_idx: int, deadline: float
+        self,
+        expected: set,
+        round_idx: int,
+        deadline: float,
+        prebuffered: Optional[Dict[int, np.ndarray]] = None,
     ) -> Dict[int, np.ndarray]:
         import zmq
 
-        received: Dict[int, np.ndarray] = {}
+        received: Dict[int, np.ndarray] = {
+            s: v for s, v in (prebuffered or {}).items() if s in expected
+        }
         poller = zmq.Poller()
         poller.register(self._pull, zmq.POLLIN)
         while expected - set(received) and time.monotonic() < deadline:

@@ -135,7 +135,9 @@ class TestALIE:
         assert zs[0] <= zs[1] <= zs[2], zs
         assert zs[2] < 3.0  # stays a *little* deviation
 
-    def test_distributed_backend_rejected(self):
+    def test_alie_dmtt_distributed_rejected(self):
+        # DMTTNodeProcess has no coalition branch; alie there would be a
+        # silent no-op attack (round-5 review finding) — must fail loud.
         from murmura_tpu.config import Config
         from murmura_tpu.utils.factories import ConfigError, build_attack
 
@@ -155,10 +157,58 @@ class TestALIE:
                                       "num_classes": 2}},
                 "backend": "distributed",
                 "distributed": {"transport": "ipc"},
+                "mobility": {"area_size": 50.0, "comm_range": 30.0,
+                              "max_speed": 5.0, "seed": 7},
+                "dmtt": {"budget_B": 3},
             }
         )
-        with pytest.raises(ConfigError, match="colluding"):
+        with pytest.raises(ConfigError, match="DMTT"):
             build_attack(cfg)
+
+    def test_alie_distributed_single_colluder_rejected(self):
+        # One colluder makes the coalition sigma 0 -> silent no-attack run;
+        # must fail loud at build time (round-5 review finding).
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import ConfigError, build_attack
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "a", "seed": 0, "rounds": 1},
+                "topology": {"type": "ring", "num_nodes": 4},
+                "aggregation": {"algorithm": "fedavg"},
+                "attack": {"enabled": True, "type": "alie",
+                            "percentage": 0.05},  # ceil-to-1 colluder
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "distributed",
+                "distributed": {"transport": "ipc"},
+            }
+        )
+        with pytest.raises(ConfigError, match="at least 2"):
+            build_attack(cfg)
+
+    def test_colluding_vector_is_paper_estimator(self):
+        # The ZMQ-backend estimator (coalition sample, f64 host stats):
+        # mu - z*sigma over the colluders' own benign states.
+        from murmura_tpu.attacks.alie import colluding_vector
+
+        rng = np.random.default_rng(3)
+        sample = rng.normal(size=(4, 16)).astype(np.float32)
+        out = colluding_vector(sample, z=1.2)
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(
+            out, sample.mean(0) - 1.2 * sample.std(0), atol=1e-6
+        )
+        # Single colluder: sigma undefined-in-spirit, degenerates to the
+        # benign state rather than fabricating a deviation.
+        np.testing.assert_allclose(
+            colluding_vector(sample[:1], z=5.0), sample[0], atol=1e-6
+        )
 
     def test_network_runs_and_biases_fedavg(self):
         from murmura_tpu.config import Config

@@ -201,6 +201,50 @@ class TestFullStack:
 
 
 @pytest.mark.slow
+class TestALIEDistributed:
+    def test_alie_ipc_run_with_coalition_statistics(self, tmp_path):
+        """ALIE on the ZMQ backend: colluders exchange benign states
+        in-coalition (COLLUDE_STATE) and broadcast the paper's mu - z*sigma
+        estimate.  The run must complete every round with finite honest
+        metrics — the attack's stealth construction must not crash or
+        stall the wall-clock round protocol."""
+        from murmura_tpu.distributed.runner import DistributedRunner
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "alie-dist", "seed": 42, "rounds": 2},
+                "topology": {"type": "ring", "num_nodes": 4},
+                "aggregation": {"algorithm": "krum",
+                                "params": {"num_compromised": 1}},
+                "attack": {"enabled": True, "type": "alie",
+                            "percentage": 0.5},  # 2 colluders: real sigma
+                "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+                "data": {
+                    "adapter": "synthetic",
+                    "params": {"num_samples": 320, "input_dim": 16,
+                                "num_classes": 4},
+                },
+                "model": {
+                    "factory": "mlp",
+                    "params": {"input_dim": 16, "num_classes": 4,
+                                "hidden_dims": [16]},
+                },
+                "backend": "distributed",
+                "distributed": {
+                    "transport": "ipc",
+                    "ipc_dir": str(tmp_path),
+                    "round_duration_s": 45.0,
+                    "startup_grace_s": 60.0,
+                },
+            }
+        )
+        history = DistributedRunner(cfg).run()
+        assert history["round"] == [1, 2], history
+        assert np.isfinite(history["honest_accuracy"]).all()
+        assert np.isfinite(history["mean_loss"]).all()
+
+
+@pytest.mark.slow
 class TestFaultInjection:
     def test_node_killed_mid_run_degrades_gracefully(self, tmp_path):
         """SIGKILL one node during round 2 of a 6-node IPC run: the
@@ -315,6 +359,36 @@ class TestMonitorFlush:
         assert mon.history["round"] == [1, 2]
         assert mon.history["reporting_nodes"] == [3, 2]
         assert mon.history["mean_accuracy"][1] == pytest.approx(0.8)
+
+    def test_partial_flush_fills_wholly_unreported_gap_rounds(self):
+        # Round 0 reported, round 1 has ZERO buffered messages, round 2
+        # reported: the partial flush must emit a NaN row (reporting_nodes
+        # 0) for round 1 so history['round'] stays gap-free (round-4
+        # advisor: the old loop advanced straight past the hole).
+        mon = self._monitor(nodes=2, rounds=3)
+        for node in range(2):
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                          "loss": 1.0})
+        mon._ingest({"round": 2, "node": 0, "accuracy": 0.9, "loss": 0.2})
+        mon._flush_complete()
+        mon._flush_partial()
+        assert mon.history["round"] == [1, 2, 3]
+        assert mon.history["reporting_nodes"] == [2, 0, 1]
+        assert np.isnan(mon.history["mean_accuracy"][1])
+        assert mon.history["mean_accuracy"][2] == pytest.approx(0.9)
+
+    def test_out_of_range_round_tag_is_dropped(self):
+        # One corrupt METRICS frame with a huge round tag must not drive
+        # an unbounded NaN-row gap fill (round-5 review finding).
+        mon = self._monitor(nodes=2, rounds=3)
+        for node in range(2):
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                          "loss": 1.0})
+        mon._ingest({"round": 10**9, "node": 0, "accuracy": 0.1,
+                      "loss": 9.9})
+        mon._flush_complete()
+        mon._flush_partial()
+        assert mon.history["round"] == [1]
 
     def test_all_skipped_round_records_nan_row(self):
         mon = self._monitor(nodes=2, rounds=1)

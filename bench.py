@@ -191,7 +191,9 @@ def main():
     # Headline config (float32 resident params) plus — on the chip — the
     # bf16-resident-params lever (tpu.param_dtype, the documented large-N
     # setting: halves the [N, P] state and the SGD update's HBM traffic).
-    # The better variant becomes the headline number, both are recorded.
+    # The float32 number stays the headline so round-over-round trend
+    # tables remain apples-to-apples (round-4 advisor); the lever is
+    # reported separately in ``variants``/``bf16_lever_rounds_per_sec``.
     # The CPU fallback skips the lever (bf16 is emulated and slow there).
     # A failure in the optional lever must not discard the already-measured
     # headline (same attributable-fallback principle as the probe retries).
@@ -202,7 +204,7 @@ def main():
             variants.append(measure("bfloat16"))
         except Exception as e:
             lever_error = f"{type(e).__name__}: {e}"[:300]
-    best = max(variants, key=lambda v: v["rounds_per_sec"])
+    best = variants[0]
     rounds_per_sec = best["rounds_per_sec"]
 
     # MFU: XLA's own flop count for the per-round train program (local SGD
@@ -237,6 +239,10 @@ def main():
                         v["param_dtype"]: round(v["rounds_per_sec"], 3)
                         for v in variants
                     },
+                    "bf16_lever_rounds_per_sec": next(
+                        (round(v["rounds_per_sec"], 3) for v in variants
+                         if v["param_dtype"] == "bfloat16"), None
+                    ),
                     "lever_error": lever_error,
                     "north_star_256node": north_star,
                     "north_star_error": north_star_error,

@@ -43,14 +43,21 @@ def make_femnist_cnn(
     channels_in: int = 1,
     name: str = None,
     compute_dtype=None,
+    conv_impl: str = "direct",
 ) -> Model:
-    """Build a FEMNIST CNN ``Model`` for 28x28x1 inputs."""
+    """Build a FEMNIST CNN ``Model`` for 28x28x1 inputs.
+
+    ``conv_impl="im2col"`` routes the conv layers through the
+    patch-GEMM formulation (models/core.py conv2d) — the local-SGD
+    lever candidate measured by bench_sgd_micro.py.
+    """
     if variant not in FEMNIST_VARIANTS:
         raise ValueError(
             f"Unknown FEMNIST variant '{variant}' (choose from {list(FEMNIST_VARIANTS)})"
         )
     conv_channels, kernel, fc_dims = FEMNIST_VARIANTS[variant]
     cd = resolve_dtype(compute_dtype)
+    ci = conv_impl
     # xlarge applies conv1,conv2 then pool, conv3 then pool (reference:
     # examples/leaf/models.py:159-169); others pool after every conv.
     final_hw = image_size // 4
@@ -78,13 +85,13 @@ def make_femnist_cnn(
         n_conv = len(params["convs"])
         if n_conv == 2:
             for conv_p in params["convs"]:
-                x = jax.nn.relu(conv2d(conv_p, x, dtype=cd))
+                x = jax.nn.relu(conv2d(conv_p, x, dtype=cd, impl=ci))
                 x = max_pool(x)
         else:
-            x = jax.nn.relu(conv2d(params["convs"][0], x, dtype=cd))
-            x = jax.nn.relu(conv2d(params["convs"][1], x, dtype=cd))
+            x = jax.nn.relu(conv2d(params["convs"][0], x, dtype=cd, impl=ci))
+            x = jax.nn.relu(conv2d(params["convs"][1], x, dtype=cd, impl=ci))
             x = max_pool(x)
-            x = jax.nn.relu(conv2d(params["convs"][2], x, dtype=cd))
+            x = jax.nn.relu(conv2d(params["convs"][2], x, dtype=cd, impl=ci))
             x = max_pool(x)
         x = x.reshape((x.shape[0], -1))
         for fc in params["fcs"][:-1]:
@@ -109,10 +116,12 @@ def make_celeba_cnn(
     fc_dim: int = 256,
     name: str = "leaf.celeba",
     compute_dtype=None,
+    conv_impl: str = "direct",
 ) -> Model:
     """LeNet-style CelebA CNN for 84x84 RGB
     (reference: murmura/examples/leaf/datasets.py:235-297)."""
     cd = resolve_dtype(compute_dtype)
+    ci = conv_impl
     n_conv = len(channels)
     final_hw = image_size // (2**n_conv)
     flat_dim = final_hw * final_hw * channels[-1]
@@ -130,7 +139,7 @@ def make_celeba_cnn(
 
     def apply(params, x, key=None, train=False):
         for conv_p in params["convs"]:
-            x = jax.nn.relu(conv2d(conv_p, x, dtype=cd))
+            x = jax.nn.relu(conv2d(conv_p, x, dtype=cd, impl=ci))
             x = max_pool(x)
         x = x.reshape((x.shape[0], -1))
         x = jax.nn.relu(dense(params["fcs"][0], x, cd))

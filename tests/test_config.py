@@ -93,3 +93,34 @@ def test_dmtt_requires_mobility():
         {**BASIC, "dmtt": {"budget_B": 3}, "mobility": {"comm_range": 30.0}}
     )
     assert cfg.mobility is not None
+
+
+def test_param_dtype_auto_large_n_default():
+    """tpu.param_dtype None = auto: bfloat16 from 64 nodes (the documented
+    large-N setting bench.py's 256-node north-star runs), float32 below;
+    an explicit setting always wins (factories.resolved_param_dtype)."""
+    from murmura_tpu.utils.factories import resolved_param_dtype
+
+    def cfg(nodes, **tpu):
+        return Config.model_validate(
+            {
+                "experiment": {"name": "pd", "seed": 0, "rounds": 1},
+                "topology": {"type": "ring", "num_nodes": nodes},
+                "aggregation": {"algorithm": "fedavg"},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "num_classes": 2}},
+                "backend": "tpu",
+                "tpu": tpu,
+            }
+        )
+
+    assert resolved_param_dtype(cfg(8)) == "float32"
+    assert resolved_param_dtype(cfg(64)) == "bfloat16"
+    assert resolved_param_dtype(cfg(256, param_dtype="float32")) == "float32"
+    assert resolved_param_dtype(cfg(8, param_dtype="bfloat16")) == "bfloat16"
+    sim = cfg(256).model_copy(update={"backend": "simulation"})
+    assert resolved_param_dtype(sim) is None

@@ -82,3 +82,42 @@ def test_dropout_only_active_in_train_mode():
     train_a = model.apply(params, x, jax.random.PRNGKey(1), True)
     train_b = model.apply(params, x, jax.random.PRNGKey(2), True)
     assert not np.allclose(np.asarray(train_a), np.asarray(train_b))
+
+
+def test_conv2d_im2col_matches_direct():
+    """The im2col lowering (patch GEMM — the bench_sgd_micro local-SGD
+    lever) must be numerically equivalent to lax.conv with the SAME HWIO
+    parameters; this also pins conv_general_dilated_patches' channel-major
+    feature order that the weight transpose in models/core.py relies on."""
+    import jax
+    import numpy as np
+
+    from murmura_tpu.models.core import conv2d, conv_init
+
+    key = jax.random.PRNGKey(0)
+    p = conv_init(key, 5, 5, 3, 8)
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 12, 12, 3))
+    direct = conv2d(p, x)
+    gemm = conv2d(p, x, impl="im2col")
+    np.testing.assert_allclose(
+        np.asarray(direct), np.asarray(gemm), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_femnist_conv_impl_flag_equivalent_and_checkpoint_compatible():
+    """conv_impl='im2col' on the FEMNIST CNN: identical init tree (same
+    HWIO params — checkpoints interchangeable) and matching logits."""
+    import jax
+    import numpy as np
+
+    from murmura_tpu.models.cnn import make_femnist_cnn
+
+    direct = make_femnist_cnn(variant="tiny")
+    gemm = make_femnist_cnn(variant="tiny", conv_impl="im2col")
+    params = direct.init(jax.random.PRNGKey(3))
+    x = jax.random.normal(jax.random.PRNGKey(4), (2, 28, 28, 1))
+    np.testing.assert_allclose(
+        np.asarray(direct.apply(params, x)),
+        np.asarray(gemm.apply(params, x)),
+        rtol=1e-4, atol=1e-4,
+    )

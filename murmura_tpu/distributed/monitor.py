@@ -80,7 +80,7 @@ class Monitor:
     def _ingest(self, metrics: dict) -> None:
         r = int(metrics.get("round", -1))
         n = int(metrics.get("node", -1))
-        if r < 0 or n < 0:
+        if r < 0 or r >= self.rounds or n < 0:
             return
         self._buffer.setdefault(r, {})[n] = metrics
 
@@ -95,11 +95,24 @@ class Monitor:
 
     def _flush_partial(self) -> None:
         """Hard deadline passed: flush incomplete rounds in order
-        (monitor.py:110-128)."""
-        for r in sorted(self._buffer):
-            if r > self._flushed_through and self._buffer[r]:
-                self._record_round(r, self._buffer[r])
-                self._flushed_through = r
+        (monitor.py:110-128).
+
+        Rounds with zero buffered messages between flushed ones get a NaN
+        row (reporting_nodes=0) instead of being skipped over, so
+        ``history['round']`` stays gap-free and index-aligned (round-4
+        advisor: advancing past a wholly-unreported round left a silent
+        hole, unlike the all-skipped case which already records NaNs).
+        """
+        reported = [r for r in self._buffer if self._buffer[r]]
+        if not reported:
+            self._buffer.clear()
+            return
+        # Clamp to the configured horizon: one corrupt METRICS frame with
+        # a huge round tag must not drive an unbounded NaN-row loop.
+        last = min(max(reported), self.rounds - 1)
+        for r in range(self._flushed_through + 1, last + 1):
+            self._record_round(r, self._buffer.get(r, {}))
+            self._flushed_through = r
         self._buffer.clear()
 
     def _record_round(self, round_idx: int, per_node: Dict[int, dict]) -> None:

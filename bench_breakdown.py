@@ -29,9 +29,11 @@ from pathlib import Path
 import jax
 import jax.numpy as jnp
 
-# Set by --smoke: tiny shapes + short chains, print-only (the committed
-# bench_breakdown.json is a TPU artifact and must not be clobbered by a
-# CPU correctness check).
+# Set by --smoke: tiny shapes + short chains, written to the separate
+# bench_breakdown_cpu_smoke.json (the committed bench_breakdown.json is a
+# TPU artifact and must not be clobbered by a CPU correctness check; the
+# smoke artifact exists so the probe-segment numbers the round-3 code
+# added have a committed capture even while the tunnel is down).
 SMOKE = False
 
 
@@ -280,10 +282,12 @@ def main():
     }
     if SMOKE:
         blob["smoke"] = True
+        out = "bench_breakdown_cpu_smoke.json"
     else:
-        Path(__file__).with_name("bench_breakdown.json").write_text(
-            json.dumps(blob, indent=2) + "\n"
-        )
+        out = "bench_breakdown.json"
+    Path(__file__).with_name(out).write_text(
+        json.dumps(blob, indent=2) + "\n"
+    )
     print(json.dumps(blob))
 
 

@@ -1,0 +1,101 @@
+"""256-node north-star program shape, executed SHARDED on an 8-virtual-
+device CPU mesh — the outage-proof half of BASELINE.json's headline
+scenario (256-node Krum FEMNIST, `north_star_256node` in bench.py).
+
+What this proves while the TPU tunnel is down: the exact program the
+north-star runs on chip — 256-node krum over the O(degree) circulant
+(ppermute) exchange, gaussian attack, fused multi-round dispatch, node
+axis sharded over a mesh — compiles AND executes end-to-end with the node
+axis split 32-per-device, and how long a round takes on this 1-core CPU
+host.  What it does NOT prove: TPU throughput (the model here is the tiny
+variant and the host is a single CPU core; bf16 resident params are
+skipped because CPU emulates bf16).  bench.py measures the real thing
+(baseline CNN, bfloat16, real chip) the moment the tunnel returns.
+
+Writes NORTH_STAR_CPU_MESH.json.
+"""
+
+import json
+import os
+import time
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    from murmura_tpu.config import Config
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    rounds = 2
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": "north-star-cpu-mesh", "seed": 7,
+                           "rounds": rounds},
+            "topology": {"type": "k-regular", "num_nodes": 256, "k": 4},
+            "aggregation": {"algorithm": "krum",
+                            "params": {"num_compromised": 1}},
+            "attack": {"enabled": True, "type": "gaussian",
+                       "percentage": 0.2, "params": {"noise_std": 10.0}},
+            "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+            "data": {
+                # one SGD step per node per round: this is an execution
+                # proof on a 1-core host, not a throughput run
+                "adapter": "synthetic",
+                "params": {"num_samples": 32 * 256,
+                           "input_shape": [28, 28, 1], "num_classes": 62},
+            },
+            # CPU-feasible stand-in for the baseline CNN; the program
+            # SHAPE (rules, exchange, fusion, sharding) is the north star's.
+            "model": {"factory": "examples.leaf.LEAFFEMNISTModel",
+                      "params": {"variant": "tiny"}},
+            "backend": "tpu",
+            "tpu": {
+                "num_devices": 8,
+                "compute_dtype": "float32",  # CPU: bf16 is emulated
+                "param_dtype": "float32",
+                "exchange": "ppermute",
+                "rounds_per_dispatch": rounds,
+                "compilation_cache_dir": "/tmp/murmura_jax_cache",
+            },
+        }
+    )
+    network = build_network_from_config(cfg)
+    t0 = time.perf_counter()
+    history = network.train(rounds=rounds, eval_every=rounds,
+                            rounds_per_dispatch=rounds)
+    block_s = time.perf_counter() - t0
+
+    acc = float(history["mean_accuracy"][-1])
+    blob = {
+        "scenario": "256-node krum ppermute gaussian, fused dispatch, "
+                    "node axis sharded over 8 virtual CPU devices "
+                    "(32 nodes/device)",
+        "model": "femnist tiny (CPU stand-in; north star on chip uses "
+                  "the baseline CNN + bfloat16 — see bench.py)",
+        "devices": jax.device_count(),
+        "backend": jax.default_backend(),
+        "rounds": rounds,
+        "block_s_including_compile": round(block_s, 2),
+        "final_mean_accuracy": round(acc, 4),
+        "finite": bool(acc == acc),
+        "note": "execution proof + CPU-host bound only, NOT a TPU "
+                "throughput claim",
+    }
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "NORTH_STAR_CPU_MESH.json"), "w") as f:
+        json.dump(blob, f, indent=2)
+    print(json.dumps(blob))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,11 +22,14 @@ Scenarios (both sides see byte-identical numpy data):
   3. krum_gaussian_mlp — scenario 1 with a 784-256-62 MLP instead of the
      CNN: the conv-lowering control.  XLA-CPU lowers the vmapped
      (grouped) convolution poorly on one core (~543 ms/step vs torch's
-     oneDNN convs), which dominates scenario 1's CPU wall clock; the MLP
-     scenario shows the same round pipeline with matmul-only models,
+     oneDNN convs), which dominates scenario 1's CPU wall clock; this
+     scenario runs the same round pipeline with a matmul-only model,
      isolating how much of the CPU speed gap is that conv path (on TPU
      the conv is MXU-native — the gap is CPU-specific, see
      docs/PERFORMANCE.md).
+  4. balance_gaussian_mlp — a second robust rule (BALANCE, reference
+     defaults) under the same attack, conv-free: independent-rule
+     accuracy comparison at comparable CPU speed.
 
 Fairness notes:
   - Both sides evaluate EVERY round (the reference's fixed cadence;
@@ -104,7 +107,16 @@ def expected_compromised():
     return sorted(rng.sample(range(NUM_NODES), num))
 
 
-SCENARIOS = ("krum_gaussian", "fedavg_clean", "krum_gaussian_mlp")
+SCENARIOS = (
+    "krum_gaussian",
+    "fedavg_clean",
+    "krum_gaussian_mlp",
+    # Second robust rule, conv-free so speed is comparable on CPU too:
+    # BALANCE's tightening-threshold accept/reject dynamics vs the same
+    # colluder-free gaussian attack (reference defaults gamma=2.0,
+    # kappa=1.0, alpha=0.5 on both sides).
+    "balance_gaussian_mlp",
+)
 
 
 # --------------------------------------------------------------------------
@@ -120,7 +132,11 @@ def run_reference(out_path: str):
     from murmura import Network
     from murmura.core import Node
     from murmura.topology import create_topology
-    from murmura.aggregation import FedAvgAggregator, KrumAggregator
+    from murmura.aggregation import (
+        BALANCEAggregator,
+        FedAvgAggregator,
+        KrumAggregator,
+    )
     from murmura.attacks.gaussian import GaussianAttack
     from murmura.data import DatasetAdapter
     from murmura.utils import set_seed
@@ -138,7 +154,7 @@ def run_reference(out_path: str):
         set_seed(SEED)
         topology = create_topology("k-regular", num_nodes=NUM_NODES, k=4)
 
-        attacked = scenario.startswith("krum_gaussian")
+        attacked = "gaussian" in scenario
         attack = None
         if attacked:
             attack = GaussianAttack(
@@ -159,6 +175,13 @@ def run_reference(out_path: str):
                 )
             return FEMNISTTiny(num_classes=NUM_CLASSES)
 
+        def make_agg():
+            if scenario.startswith("krum"):
+                return KrumAggregator(num_compromised=KRUM_F)
+            if scenario.startswith("balance"):
+                return BALANCEAggregator(total_rounds=ROUNDS)
+            return FedAvgAggregator()
+
         nodes = []
         for node_id in range(NUM_NODES):
             train_ds = adapter.get_client_data(node_id)
@@ -169,8 +192,7 @@ def run_reference(out_path: str):
                                         shuffle=True),
                 test_loader=DataLoader(train_ds, batch_size=BATCH_SIZE,
                                        shuffle=False),
-                aggregator=(KrumAggregator(num_compromised=KRUM_F)
-                            if attacked else FedAvgAggregator()),
+                aggregator=make_agg(),
                 device=torch.device("cpu"),
             ))
 
@@ -223,18 +245,20 @@ def run_tpu(out_path: str):
 
     def build(scenario):
         topology = create_topology("k-regular", num_nodes=NUM_NODES, k=4)
-        attacked = scenario.startswith("krum_gaussian")
+        attacked = "gaussian" in scenario
         attack = None
         if attacked:
             attack = make_gaussian_attack(
                 num_nodes=NUM_NODES, attack_percentage=ATTACK_PCT,
                 noise_std=NOISE_STD, seed=SEED,
             )
-        agg = build_aggregator(
-            "krum" if attacked else "fedavg",
-            {"num_compromised": KRUM_F} if attacked else {},
-            total_rounds=ROUNDS,
-        )
+        if scenario.startswith("krum"):
+            algo, params = "krum", {"num_compromised": KRUM_F}
+        elif scenario.startswith("balance"):
+            algo, params = "balance", {}
+        else:
+            algo, params = "fedavg", {}
+        agg = build_aggregator(algo, params, total_rounds=ROUNDS)
         if scenario.endswith("_mlp"):
             from murmura_tpu.models.mlp import make_mlp
 
@@ -348,7 +372,7 @@ def orchestrate():
             },
             "checks": checks,
         }
-        if scenario.startswith("krum_gaussian"):
+        if "gaussian" in scenario:
             comparison[scenario]["final_honest_accuracy"] = {
                 "reference": (rh.get("honest_accuracy") or [None])[-1],
                 "murmura_tpu": (th.get("honest_accuracy") or [None])[-1],

@@ -5,12 +5,14 @@ from murmura_tpu.attacks.gaussian import make_gaussian_attack
 from murmura_tpu.attacks.directed import make_directed_deviation_attack
 from murmura_tpu.attacks.topology_liar import make_topology_liar_attack, false_claims
 from murmura_tpu.attacks.alie import make_alie_attack
+from murmura_tpu.attacks.ipm import make_ipm_attack
 
 ATTACKS = {
     "gaussian": make_gaussian_attack,
     "directed_deviation": make_directed_deviation_attack,
     "topology_liar": make_topology_liar_attack,
     "alie": make_alie_attack,
+    "ipm": make_ipm_attack,
 }
 
 __all__ = [
@@ -20,6 +22,7 @@ __all__ = [
     "make_directed_deviation_attack",
     "make_topology_liar_attack",
     "make_alie_attack",
+    "make_ipm_attack",
     "false_claims",
     "ATTACKS",
 ]

@@ -29,7 +29,7 @@ differently:
   (``MsgType.COLLUDE_STATE`` — attackers coordinate out-of-band by
   construction) and estimates mu/sigma from the coalition sample.  This
   IS the paper's estimator; see
-  ``NodeProcess._alie_colluding_state``/``colluding_vector`` below.
+  ``NodeProcess._colluding_state``/``colluding_vector`` below.
 """
 
 from statistics import NormalDist
@@ -38,7 +38,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from murmura_tpu.attacks.base import Attack, select_compromised
+from murmura_tpu.attacks.base import Attack, honest_mean, select_compromised
 
 
 def alie_z_max(num_nodes: int, num_compromised: int) -> float:
@@ -61,7 +61,7 @@ def resolve_alie_z(
 ) -> float:
     """Single z-resolution rule shared by the jitted attack
     (make_alie_attack) and the ZMQ coalition path
-    (NodeProcess._alie_colluding_state): explicit override wins, else the
+    (NodeProcess._colluding_state): explicit override wins, else the
     paper's z_max."""
     return float(z) if z is not None else alie_z_max(num_nodes, num_compromised)
 
@@ -96,18 +96,18 @@ def make_alie_attack(
             # Per-node view: no honest-population statistics exist here.
             # The ZMQ backend never routes ALIE through this function —
             # NodeProcess._execute_round branches to the coalition
-            # estimator (_alie_colluding_state) instead, and the factory
+            # estimator (_colluding_state) instead, and the factory
             # rejects the one distributed path without that branch
             # (alie+dmtt).  Reachable only from direct library use; pass
             # through rather than fabricate a non-colluding variant.
             return flat
-        # Honest-population coordinate statistics in f32 (a bf16 variance
-        # over N rows would quantize the small sigmas the stealth margin
-        # depends on).
+        # Honest-population coordinate statistics in f32 (base.honest_mean;
+        # the variance shares its mask/count for the same bf16-quantization
+        # reason).
         f32 = flat.astype(jnp.float32)
         hm = (1.0 - compromised_mask.astype(jnp.float32))[:, None]  # [N, 1]
         cnt = jnp.maximum(hm.sum(), 1.0)
-        mu = (f32 * hm).sum(axis=0, keepdims=True) / cnt
+        mu = honest_mean(flat, compromised_mask)
         var = (jnp.square(f32 - mu) * hm).sum(axis=0, keepdims=True) / cnt
         malicious = (mu - z_val * jnp.sqrt(var)).astype(flat.dtype)  # [1, P]
         # Elementwise select, not scatter (same layout rationale as the

@@ -35,6 +35,17 @@ def select_compromised(num_nodes: int, percentage: float, seed: int = 42) -> np.
     return mask
 
 
+def honest_mean(flat: jnp.ndarray, compromised_mask: jnp.ndarray) -> jnp.ndarray:
+    """[1, P] mean over the honest rows of the broadcast tensor, reduced
+    in f32 regardless of param dtype (a bf16 accumulation over N rows
+    would quantize the statistics the colluding attacks manipulate).
+    Shared by the omniscient paths of ALIE and IPM."""
+    f32 = flat.astype(jnp.float32)
+    hm = (1.0 - compromised_mask.astype(jnp.float32))[:, None]  # [N, 1]
+    cnt = jnp.maximum(hm.sum(), 1.0)
+    return (f32 * hm).sum(axis=0, keepdims=True) / cnt
+
+
 @dataclass(frozen=True)
 class Attack:
     """A named attack with its compromised set and pure state transform."""

@@ -1,0 +1,73 @@
+"""IPM — inner-product manipulation colluding attack (Xie, Koyejo &
+Gupta, UAI 2020 "Fall of Empires").  No reference counterpart (murmura
+ships gaussian / directed_deviation / topology_liar); included beyond
+parity as the second canonical colluding attack the robust-aggregation
+literature evaluates against, complementing ALIE:
+
+    malicious = -epsilon * mu_honest
+
+Every colluder broadcasts the negated (scaled) honest mean, so the inner
+product between the aggregate and the true descent direction is driven
+negative (epsilon >= 1 flips the update outright; small epsilon slows
+convergence while staying inside distance filters — the stealth regime).
+Where ALIE hides inside the per-coordinate variance envelope, IPM attacks
+the *direction* of the aggregate.
+
+Backend realization mirrors ALIE exactly (attacks/alie.py module
+docstring): the jitted backends use the omniscient honest-population mean
+(strictly stronger than the paper's estimator); the ZMQ backend estimates
+the mean from the coalition's own benign states via the same
+COLLUDE_STATE exchange (``NodeProcess._colluding_state``); a single
+colluder degenerates to broadcasting ``-epsilon * own_benign_state``,
+which — unlike ALIE's sigma=0 case — is still a real attack, so no
+minimum-coalition guard is needed.
+"""
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from murmura_tpu.attacks.base import Attack, honest_mean, select_compromised
+
+# Shared by the factory and the ZMQ coalition path so the two backends
+# resolve the same epsilon for the same config (the resolve_alie_z
+# pattern).
+DEFAULT_EPSILON = 1.5
+
+
+def resolve_ipm_epsilon(epsilon: Optional[float] = None) -> float:
+    return DEFAULT_EPSILON if epsilon is None else float(epsilon)
+
+
+def ipm_vector(benign_states: np.ndarray, epsilon: float) -> np.ndarray:
+    """The paper's malicious vector from a coalition sample ([M, P]):
+    -epsilon * mean.  f64 host statistics, f32 wire dtype (same contract
+    as alie.colluding_vector)."""
+    s = np.asarray(benign_states, dtype=np.float64)
+    return (-float(epsilon) * s.mean(axis=0)).astype(np.float32)
+
+
+def make_ipm_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    epsilon: Optional[float] = None,
+    seed: int = 42,
+) -> Attack:
+    compromised = select_compromised(num_nodes, attack_percentage, seed)
+    comp_idx = np.flatnonzero(compromised)
+    eps = resolve_ipm_epsilon(epsilon)
+
+    def apply(flat, compromised_mask, key, round_idx):
+        if flat.shape[0] != num_nodes or not len(comp_idx):
+            # Per-node view: the ZMQ backend routes IPM through the
+            # coalition estimator (NodeProcess._colluding_state), never
+            # through this function — reachable only from direct library
+            # use; pass through (same contract as alie.py).
+            return flat
+        malicious = (-eps * honest_mean(flat, compromised_mask)).astype(
+            flat.dtype
+        )  # [1, P]
+        return jnp.where(compromised_mask[:, None] > 0, malicious, flat)
+
+    return Attack(name="ipm", compromised=compromised, apply=apply)

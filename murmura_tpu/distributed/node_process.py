@@ -228,10 +228,12 @@ class NodeProcess:
             self._execute_round(k)
 
     @property
-    def _is_alie_colluder(self) -> bool:
+    def _is_colluder(self) -> bool:
+        """Colluding attacks (ALIE, IPM) estimate population statistics
+        from the coalition's own benign states on this backend."""
         return (
             self.attack is not None
-            and self.attack.name == "alie"
+            and self.attack.name in ("alie", "ipm")
             and self.is_compromised
         )
 
@@ -241,10 +243,10 @@ class NodeProcess:
         neighbors = self.current_neighbors(round_idx)
 
         # 1. local training (honest only — node_process.py:205-207).
-        # ALIE colluders ALSO train: their benign states are the coalition
-        # sample the paper's mu/sigma estimator runs on (alie.py module
+        # ALIE/IPM colluders ALSO train: their benign states are the
+        # coalition sample the papers' estimators run on (alie.py module
         # docstring); the benign result never leaves the coalition.
-        if not self.is_compromised or self._is_alie_colluder:
+        if not self.is_compromised or self._is_colluder:
             self.node.local_train(round_idx)
 
         # 2. overrun check: skip exchange if training blew the window
@@ -258,14 +260,14 @@ class NodeProcess:
             self._send_metrics(round_idx, skipped=True)
             return
 
-        # 3. attack own outgoing state (node_process.py:221-225).  ALIE
-        # colluders first exchange benign states within the coalition;
-        # neighbor MODEL_STATEs arriving during that window are buffered
-        # and handed to the collection in step 5.
+        # 3. attack own outgoing state (node_process.py:221-225).
+        # ALIE/IPM colluders first exchange benign states within the
+        # coalition; neighbor MODEL_STATEs arriving during that window are
+        # buffered and handed to the collection in step 5.
         flat = self.node.get_flat_state()
         prebuffered: Dict[int, np.ndarray] = {}
-        if self._is_alie_colluder:
-            out_flat, prebuffered = self._alie_colluding_state(
+        if self._is_colluder:
+            out_flat, prebuffered = self._colluding_state(
                 flat, round_idx, deadline
             )
         else:
@@ -310,30 +312,23 @@ class NodeProcess:
         )
         return np.asarray(out[0], dtype=np.float32)
 
-    def _alie_colluding_state(
+    def _colluding_state(
         self, flat: np.ndarray, round_idx: int, deadline: float
     ) -> tuple:
-        """Coalition-estimated ALIE vector (the paper's construction —
-        Baruch et al. estimate population mu/sigma from the corrupted
-        workers' own benign gradients; module docstring of attacks/alie.py
-        has the omniscient-vs-estimated distinction).
+        """Coalition-estimated colluding vector — ALIE's mu - z*sigma
+        (Baruch et al.) or IPM's -epsilon*mu (Xie et al.), both estimated
+        from the corrupted workers' own benign states, which is the
+        papers' construction (module docstrings of attacks/alie.py and
+        attacks/ipm.py have the omniscient-vs-estimated distinction).
 
         Protocol: push own benign state to every other colluder
         (COLLUDE_STATE), collect theirs until half the remaining round
-        window is spent, then broadcast mu - z*sigma over whatever
+        window is spent, then broadcast the colluding vector over whatever
         coalition sample arrived (always >= the own state — the same
         partial-collect degradation the model exchange uses).  Neighbor
         MODEL_STATEs arriving early are buffered and returned for step 5.
         """
         import zmq
-
-        from murmura_tpu.attacks.alie import colluding_vector, resolve_alie_z
-
-        z = resolve_alie_z(
-            self.config.topology.num_nodes,
-            len(self.compromised_ids),
-            self.config.attack.params.get("z"),
-        )
         peers = sorted(self.compromised_ids - {self.node_id})
         payload = pack_state(flat)
         for nid in peers:
@@ -371,11 +366,31 @@ class NodeProcess:
         missing = set(peers) - set(coalition)
         if missing:
             print(
-                f"[node {self.node_id}] alie: coalition sample "
-                f"{len(coalition)}/{len(peers) + 1} (missing {sorted(missing)})",
+                f"[node {self.node_id}] {self.attack.name}: coalition "
+                f"sample {len(coalition)}/{len(peers) + 1} "
+                f"(missing {sorted(missing)})",
                 flush=True,
             )
-        out = colluding_vector(np.stack(list(coalition.values())), z)
+        sample = np.stack(list(coalition.values()))
+        p = self.config.attack.params
+        if self.attack.name == "ipm":
+            from murmura_tpu.attacks.ipm import ipm_vector, resolve_ipm_epsilon
+
+            out = ipm_vector(sample, resolve_ipm_epsilon(p.get("epsilon")))
+        else:
+            from murmura_tpu.attacks.alie import (
+                colluding_vector,
+                resolve_alie_z,
+            )
+
+            out = colluding_vector(
+                sample,
+                resolve_alie_z(
+                    self.config.topology.num_nodes,
+                    len(self.compromised_ids),
+                    p.get("z"),
+                ),
+            )
         return out, prebuffered
 
     def _collect_states(

@@ -47,43 +47,51 @@ def build_attack(config: Config) -> Optional[Attack]:
             lambda_param=float(p.get("lambda_param", -5.0)),
             seed=seed,
         )
-    if config.attack.type == "alie":
-        # On simulation/tpu the jitted round step computes the colluding
-        # vector from the TRUE honest rows (omniscient variant — stronger
-        # than the paper's construction; alie.py docstring).  On the ZMQ
-        # backend each colluding NodeProcess instead estimates mu/sigma
-        # from the coalition's own benign states (the paper's estimator) —
-        # see NodeProcess._alie_colluding_state.
-        if config.backend == "distributed":
-            if config.dmtt is not None:
-                # DMTTNodeProcess overrides _execute_round without the
-                # coalition branch; letting alie fall through to the
-                # per-node apply() would silently run NO attack while the
-                # experiment reports "under ALIE" — fail loud instead.
-                raise ConfigError(
-                    "attack type 'alie' is not wired into the DMTT "
-                    "distributed round protocol; use backend: "
-                    "simulation/tpu for alie+dmtt, or a different attack "
-                    "on the distributed backend"
-                )
-            from murmura_tpu.attacks.base import select_compromised
+    if config.attack.type in ("alie", "ipm"):
+        # Colluding attacks: on simulation/tpu the jitted round step
+        # computes the colluding vector from the TRUE honest rows
+        # (omniscient variant — stronger than the papers' constructions;
+        # alie.py/ipm.py docstrings).  On the ZMQ backend each colluding
+        # NodeProcess instead estimates the statistics from the
+        # coalition's own benign states (the papers' estimators) — see
+        # NodeProcess._colluding_state.
+        if config.backend == "distributed" and config.dmtt is not None:
+            # DMTTNodeProcess overrides _execute_round without the
+            # coalition branch; letting a colluding attack fall through to
+            # the per-node apply() would silently run NO attack while the
+            # experiment reports it ran — fail loud instead.
+            raise ConfigError(
+                f"attack type '{config.attack.type}' is not wired into "
+                "the DMTT distributed round protocol; use backend: "
+                "simulation/tpu, or a different attack on the "
+                "distributed backend"
+            )
+        if config.attack.type == "alie":
+            if config.backend == "distributed":
+                from murmura_tpu.attacks.base import select_compromised
 
-            if select_compromised(n, pct, seed).sum() < 2:
-                # The ZMQ coalition estimator needs >= 2 colluders: with
-                # one, sigma over the coalition sample is 0 and mu - z*s
-                # degenerates to the colluder's benign state — a silent
-                # no-attack run labeled "under ALIE" (the sim/tpu
-                # omniscient variant has no such minimum).
-                raise ConfigError(
-                    "attack type 'alie' on backend: distributed needs at "
-                    "least 2 compromised nodes (the coalition mu/sigma "
-                    "estimator is degenerate with 1); raise "
-                    "attack.percentage or use backend: simulation/tpu"
-                )
-        return ATTACKS["alie"](
+                if select_compromised(n, pct, seed).sum() < 2:
+                    # The ZMQ coalition estimator needs >= 2 colluders:
+                    # with one, sigma over the coalition sample is 0 and
+                    # mu - z*s degenerates to the colluder's benign state
+                    # — a silent no-attack run labeled "under ALIE" (ipm
+                    # has no such minimum: -eps*own is still an attack).
+                    raise ConfigError(
+                        "attack type 'alie' on backend: distributed needs "
+                        "at least 2 compromised nodes (the coalition "
+                        "mu/sigma estimator is degenerate with 1); raise "
+                        "attack.percentage or use backend: simulation/tpu"
+                    )
+            return ATTACKS["alie"](
+                num_nodes=n,
+                attack_percentage=pct,
+                z=p.get("z"),
+                seed=seed,
+            )
+        return ATTACKS["ipm"](
             num_nodes=n,
             attack_percentage=pct,
-            z=p.get("z"),
+            epsilon=p.get("epsilon"),
             seed=seed,
         )
     if config.attack.type == "topology_liar":
@@ -169,9 +177,9 @@ def resolve_model(config: Config, data):
         # MXU mixed precision: bfloat16 matmul/conv inputs, float32 params
         # and accumulation (tpu.compute_dtype, default bfloat16).
         model_params.setdefault("compute_dtype", config.tpu.compute_dtype)
+        factory_lc = config.model.factory.lower()
         if config.tpu.conv_impl != "direct" and (
-            "femnist" in config.model.factory
-            or "celeba" in config.model.factory
+            "femnist" in factory_lc or "celeba" in factory_lc
         ):
             # CNN-only lever; non-conv models have no im2col formulation.
             model_params.setdefault("conv_impl", config.tpu.conv_impl)

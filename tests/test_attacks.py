@@ -273,3 +273,97 @@ class TestALIE:
         )
         with pytest.raises(ConfigError, match="model_attack_type"):
             build_attack(cfg)
+
+
+class TestIPM:
+    """Beyond-parity colluding attack #2 (ipm.py; Xie et al. UAI 2020)."""
+
+    def test_compromised_rows_broadcast_negated_honest_mean(self):
+        from murmura_tpu.attacks.ipm import make_ipm_attack
+
+        atk = make_ipm_attack(10, 0.2, epsilon=2.0, seed=42)
+        rng = np.random.default_rng(1)
+        flat = jnp.asarray(rng.normal(size=(10, 32)).astype(np.float32))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        out = np.asarray(atk.apply(flat, comp, jax.random.PRNGKey(0), 0))
+
+        honest = ~atk.compromised
+        np.testing.assert_array_equal(out[honest], np.asarray(flat)[honest])
+        comp_rows = out[atk.compromised]
+        np.testing.assert_array_equal(comp_rows[0], comp_rows[1])
+        mu = np.asarray(flat)[honest].mean(axis=0)
+        np.testing.assert_allclose(comp_rows[0], -2.0 * mu, atol=1e-5)
+
+    def test_ipm_vector_estimator_and_single_colluder(self):
+        from murmura_tpu.attacks.ipm import ipm_vector
+
+        rng = np.random.default_rng(2)
+        sample = rng.normal(size=(3, 8)).astype(np.float32)
+        np.testing.assert_allclose(
+            ipm_vector(sample, 0.5), -0.5 * sample.mean(0), atol=1e-6
+        )
+        # Single colluder stays a REAL attack (sign-flipped own state),
+        # unlike ALIE's sigma=0 degeneration — no minimum-coalition guard.
+        np.testing.assert_allclose(
+            ipm_vector(sample[:1], 1.5), -1.5 * sample[0], atol=1e-6
+        )
+
+    def test_network_geometric_median_resists_fedavg_degrades(self):
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        base = {
+            "experiment": {"name": "ipm", "seed": 3, "rounds": 3},
+            "topology": {"type": "fully", "num_nodes": 8},
+            "aggregation": {"algorithm": "fedavg"},
+            "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.1},
+            "data": {"adapter": "synthetic",
+                      "params": {"num_samples": 640, "input_dim": 24,
+                                 "num_classes": 4}},
+            "model": {"factory": "mlp",
+                       "params": {"input_dim": 24, "hidden_dims": [32],
+                                  "num_classes": 4}},
+            "backend": "simulation",
+            "attack": {"enabled": True, "type": "ipm", "percentage": 0.25,
+                        "params": {"epsilon": 2.0}},
+        }
+        fed = build_network_from_config(
+            Config.model_validate(base)
+        ).train(rounds=3)
+        gm_cfg = {**base, "aggregation": {"algorithm": "geometric_median",
+                                           "params": {"max_iters": 8}}}
+        gm = build_network_from_config(
+            Config.model_validate(gm_cfg)
+        ).train(rounds=3)
+        assert np.isfinite(fed["honest_accuracy"]).all()
+        # -2x mean from 2/8 nodes drives the fedavg aggregate backwards;
+        # the geometric median downweights the identical colluding pair.
+        assert gm["honest_accuracy"][-1] > fed["honest_accuracy"][-1] + 0.1
+
+    def test_ipm_dmtt_distributed_rejected(self):
+        from murmura_tpu.config import Config
+        from murmura_tpu.utils.factories import ConfigError, build_attack
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "a", "seed": 0, "rounds": 1},
+                "topology": {"type": "ring", "num_nodes": 4},
+                "aggregation": {"algorithm": "fedavg"},
+                "attack": {"enabled": True, "type": "ipm",
+                            "percentage": 0.25},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "distributed",
+                "distributed": {"transport": "ipc"},
+                "mobility": {"area_size": 50.0, "comm_range": 30.0,
+                              "max_speed": 5.0, "seed": 7},
+                "dmtt": {"budget_B": 3},
+            }
+        )
+        with pytest.raises(ConfigError, match="DMTT"):
+            build_attack(cfg)

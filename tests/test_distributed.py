@@ -201,23 +201,27 @@ class TestFullStack:
 
 
 @pytest.mark.slow
-class TestALIEDistributed:
-    def test_alie_ipc_run_with_coalition_statistics(self, tmp_path):
-        """ALIE on the ZMQ backend: colluders exchange benign states
-        in-coalition (COLLUDE_STATE) and broadcast the paper's mu - z*sigma
-        estimate.  The run must complete every round with finite honest
-        metrics — the attack's stealth construction must not crash or
-        stall the wall-clock round protocol."""
+class TestColludingAttacksDistributed:
+    @pytest.mark.parametrize("attack_type", ["alie", "ipm"])
+    def test_colluder_ipc_run_with_coalition_statistics(
+        self, tmp_path, attack_type
+    ):
+        """Colluding attacks on the ZMQ backend: colluders exchange benign
+        states in-coalition (COLLUDE_STATE) and broadcast the papers'
+        estimated vector (ALIE mu - z*sigma / IPM -eps*mu).  The run must
+        complete every round with finite honest metrics — the coalition
+        protocol must not crash or stall the wall-clock round loop."""
         from murmura_tpu.distributed.runner import DistributedRunner
 
         cfg = Config.model_validate(
             {
-                "experiment": {"name": "alie-dist", "seed": 42, "rounds": 2},
+                "experiment": {"name": f"{attack_type}-dist", "seed": 42,
+                               "rounds": 2},
                 "topology": {"type": "ring", "num_nodes": 4},
                 "aggregation": {"algorithm": "krum",
                                 "params": {"num_compromised": 1}},
-                "attack": {"enabled": True, "type": "alie",
-                            "percentage": 0.5},  # 2 colluders: real sigma
+                "attack": {"enabled": True, "type": attack_type,
+                            "percentage": 0.5},  # 2 colluders: real stats
                 "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
                 "data": {
                     "adapter": "synthetic",

@@ -129,6 +129,81 @@ def pairwise_l2_distances(
     return jnp.sqrt(jnp.maximum(d2, 0.0))
 
 
+# Per-rolled-copy HBM budget for the circulant kernels.  A full-width
+# jnp.roll of the stacked [N, P] states materializes ~k copies at once
+# (XLA schedules the Python-unrolled offsets concurrently), and the
+# wrap-around slices ([1..k, P]) pick up a 32-128x tile-padding expansion
+# at large N — the 25 GB OOM the 256-node north-star program hit on a
+# 15.75 GB v5e chip.  Chunking the parameter axis caps the rolled working
+# set at this budget while leaving small-N programs (one chunk) with the
+# exact unchunked computation.  The P axis is never sharded (the node
+# axis is the mesh axis — parallel/mesh.py), so dynamic-slicing it is
+# GSPMD-safe and rolls on axis 0 still lower to collective-permutes.
+_CIRCULANT_CHUNK_BYTES = 256 * 1024 * 1024
+
+
+def _p_chunk_len(n: int, p: int, itemsize: int) -> int:
+    """Chunk length along P so one [N, chunk] rolled copy stays in budget."""
+    return max(1, min(p, _CIRCULANT_CHUNK_BYTES // max(1, n * itemsize)))
+
+
+def _p_chunked_accumulate(arrays, chunk_fn, acc_init, p: int, chunk: int):
+    """Reduce ``chunk_fn`` over [*, c]-slices of ``arrays`` along axis 1.
+
+    Runs floor(p/chunk) full chunks under a fori_loop (one buffer of
+    rolled temps live at a time; the carry is the small accumulator) and
+    one statically-shaped tail outside it, so no padding of P is needed.
+    """
+    nfull = p // chunk
+
+    def body(i, acc):
+        cs = [
+            jax.lax.dynamic_slice(a, (0, i * chunk), (a.shape[0], chunk))
+            for a in arrays
+        ]
+        return acc + chunk_fn(*cs)
+
+    acc = acc_init
+    if nfull:
+        acc = jax.lax.fori_loop(0, nfull, body, acc)
+    if p - nfull * chunk:
+        acc = acc + chunk_fn(*[a[:, nfull * chunk :] for a in arrays])
+    return acc
+
+
+def _p_chunked_map(arrays, chunk_fn, out_dtype, p: int, chunk: int):
+    """Assemble ``chunk_fn`` over [*, c]-slices of ``arrays`` into [N, p].
+
+    The map-flavored sibling of :func:`_p_chunked_accumulate`: full chunks
+    run under a fori_loop whose carry is the output buffer (XLA aliases
+    while-loop carries in place, so the only full-size array is the output
+    itself), and the remainder is a statically-shaped tail update.
+    """
+    n = arrays[0].shape[0]
+    nfull = p // chunk
+
+    def body(i, out):
+        cs = [
+            jax.lax.dynamic_slice(a, (0, i * chunk), (a.shape[0], chunk))
+            for a in arrays
+        ]
+        return jax.lax.dynamic_update_slice(
+            out, chunk_fn(*cs).astype(out_dtype), (0, i * chunk)
+        )
+
+    out = jnp.zeros((n, p), out_dtype)
+    if nfull:
+        out = jax.lax.fori_loop(0, nfull, body, out)
+    if p - nfull * chunk:
+        tail = nfull * chunk
+        out = jax.lax.dynamic_update_slice(
+            out,
+            chunk_fn(*[a[:, tail:] for a in arrays]).astype(out_dtype),
+            (0, tail),
+        )
+    return out
+
+
 def circulant_neighbor_distances(
     own: jnp.ndarray, bcast: jnp.ndarray, offsets
 ) -> jnp.ndarray:
@@ -141,20 +216,93 @@ def circulant_neighbor_distances(
     regardless of input dtype (XLA fuses the upcast into the reduce, no
     extra HBM pass): a bf16 accumulation over millions of terms would
     quantize the small distances the Byzantine selections rank on, same
-    hazard :func:`pairwise_l2_distances` guards against."""
-    return jnp.stack(
-        [
-            jnp.sqrt(
+    hazard :func:`pairwise_l2_distances` guards against.
+
+    Large N*P runs P-chunked (see ``_CIRCULANT_CHUNK_BYTES``): the sum over
+    P is associative, so partial sums over chunks accumulate in the same
+    f32 precision and only the final sqrt changes position — identical up
+    to f32 summation order.
+    """
+    n, p = bcast.shape
+
+    def chunk_d2(oc, bc):
+        return jnp.stack(
+            [
                 jnp.sum(
                     jnp.square(
-                        (own - jnp.roll(bcast, -o, axis=0)).astype(jnp.float32)
+                        (oc - jnp.roll(bc, -o, axis=0)).astype(jnp.float32)
                     ),
                     axis=-1,
                 )
-            )
-            for o in offsets
-        ]
+                for o in offsets
+            ]
+        )
+
+    chunk = _p_chunk_len(n, p, bcast.dtype.itemsize)
+    if chunk >= p:
+        return jnp.sqrt(chunk_d2(own, bcast))
+    d2 = _p_chunked_accumulate(
+        [own, bcast],
+        chunk_d2,
+        jnp.zeros((len(offsets), n), jnp.float32),
+        p,
+        chunk,
     )
+    return jnp.sqrt(d2)
+
+
+def circulant_weighted_sum(
+    bcast: jnp.ndarray, w_k: jnp.ndarray, offsets
+) -> jnp.ndarray:
+    """[N, P] per-offset weighted neighbor sum: sum_o w_k[o, i] * bcast[(i+o) % N].
+
+    The shared memory-safe kernel behind the circulant masked mean, the
+    fedavg roll path and evidential trust's weighted blend.  Large N*P runs
+    P-chunked with the output assembled via dynamic_update_slice on the
+    fori_loop carry (XLA aliases while-loop carries in place, so the only
+    full-size buffers are ``bcast`` and the output).
+    """
+    n, p = bcast.shape
+    out_dtype = jnp.result_type(bcast.dtype, w_k.dtype)
+
+    def chunk_sum(bc):
+        acc = jnp.zeros(bc.shape, out_dtype)
+        for idx, o in enumerate(offsets):
+            acc = acc + w_k[idx][:, None] * jnp.roll(bc, -o, axis=0)
+        return acc
+
+    chunk = _p_chunk_len(n, p, bcast.dtype.itemsize)
+    if chunk >= p:
+        return chunk_sum(bcast)
+    return _p_chunked_map([bcast], chunk_sum, out_dtype, p, chunk)
+
+
+def circulant_candidate_map(own, bcast, offsets, fn) -> jnp.ndarray:
+    """Apply a coordinate-wise reduction over the circulant candidate stack.
+
+    ``fn`` maps the stacked candidates ``[m, N, c]`` (own + one rolled
+    broadcast per offset, any chunk width c) to ``[N, c]`` and must be
+    coordinate-wise along the last axis (sorts/means over the candidate
+    axis are; anything mixing P columns is not).  Large N*P runs P-chunked
+    with the budget scaled by the stack height m, so the median and
+    trimmed-mean circulant paths never materialize the full [m, N, P]
+    tensor (the same OOM class ``_CIRCULANT_CHUNK_BYTES`` exists for).
+    """
+    n, p = bcast.shape
+    m = len(offsets) + 1
+
+    def chunk_apply(oc, bc):
+        return fn(jnp.stack([oc] + [jnp.roll(bc, -o, axis=0) for o in offsets]))
+
+    chunk = _p_chunk_len(n * m, p, bcast.dtype.itemsize)
+    if chunk >= p:
+        return chunk_apply(own, bcast)
+    out_dtype = jax.eval_shape(
+        chunk_apply,
+        jax.ShapeDtypeStruct((n, 1), own.dtype),
+        jax.ShapeDtypeStruct((n, 1), bcast.dtype),
+    ).dtype
+    return _p_chunked_map([own, bcast], chunk_apply, out_dtype, p, chunk)
 
 
 def circulant_masked_mean(
@@ -166,9 +314,7 @@ def circulant_masked_mean(
         bcast: [N, P] broadcast states.
         accept_k: [k, N] accept weight for node i's neighbor at offset o.
     """
-    acc = jnp.zeros_like(bcast)
-    for idx, o in enumerate(offsets):
-        acc = acc + accept_k[idx][:, None] * jnp.roll(bcast, -o, axis=0)
+    acc = circulant_weighted_sum(bcast, accept_k, offsets)
     cnt = accept_k.sum(axis=0)
     return acc / jnp.maximum(cnt, 1e-12)[:, None]
 

@@ -37,7 +37,11 @@ from typing import Optional, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from murmura_tpu.aggregation.base import AggContext, AggregatorDef
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    AggregatorDef,
+    circulant_weighted_sum,
+)
 from murmura_tpu.aggregation.probe import (
     circulant_probe_eval,
     evidential_trust_metric,
@@ -142,11 +146,7 @@ def make_evidential_trust(
         has_accepted = total > 0
         norm_w = weights / jnp.maximum(total, 1e-12)[None, :]
 
-        neighbor_agg = jnp.zeros_like(bcast)
-        for idx, o in enumerate(offsets):
-            neighbor_agg = neighbor_agg + norm_w[idx][:, None] * jnp.roll(
-                bcast, -o, axis=0
-            )
+        neighbor_agg = circulant_weighted_sum(bcast, norm_w, offsets)
         blended = self_weight * own + (1.0 - self_weight) * neighbor_agg
         new_flat = jnp.where(has_accepted[:, None], blended, own)
 

@@ -15,7 +15,11 @@ from typing import Optional, Sequence
 
 import jax.numpy as jnp
 
-from murmura_tpu.aggregation.base import AggContext, AggregatorDef
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    AggregatorDef,
+    circulant_weighted_sum,
+)
 
 
 def make_fedavg(
@@ -27,8 +31,9 @@ def make_fedavg(
         degree = adj.sum(axis=1)
         if offsets is not None:
             # roll(bcast, -o)[i] == bcast[(i+o) % N]: node i's neighbor at
-            # circulant offset o.
-            neighbor_sum = sum(jnp.roll(bcast, -o, axis=0) for o in offsets)
+            # circulant offset o; the shared kernel chunks P at large N*P.
+            ones = jnp.ones((len(offsets), own.shape[0]), bcast.dtype)
+            neighbor_sum = circulant_weighted_sum(bcast, ones, offsets)
         else:
             neighbor_sum = adj @ bcast
         new_flat = (own + neighbor_sum) / (1.0 + degree)[:, None]

@@ -76,12 +76,24 @@ def circulant_probe_eval(
         outputs = ctx.apply_fn(params, x_i, None, False)
         return metric_fn(outputs, y_i, m_i)
 
-    per_offset = [
-        jax.vmap(eval_one)(
-            jnp.roll(bcast, -o, axis=0), ctx.probe_x, ctx.probe_y, ctx.probe_mask
-        )
-        for o in offsets
-    ]
+    # Serialize the offsets so only ONE rolled [N, P] copy is live at a
+    # time: an unconstrained Python-unrolled loop lets XLA schedule all k
+    # rolls concurrently — the 256-node OOM class the chunked kernels in
+    # base.py exist for.  The shifts stay STATIC (a traced shift under
+    # lax.map would lower to a [2N, P] concat + dynamic_slice and defeat
+    # node-axis sharding); ordering is imposed by gating each roll's input
+    # on the previous offset's metrics via optimization_barrier.  The probe
+    # forwards dominate the cost, so losing cross-offset parallelism is
+    # free.
+    per_offset = []
+    gate = bcast
+    for o in offsets:
+        rolled = jnp.roll(gate, -o, axis=0)
+        m = jax.vmap(eval_one)(rolled, ctx.probe_x, ctx.probe_y, ctx.probe_mask)
+        gate = jax.lax.optimization_barrier(
+            (bcast, jax.tree_util.tree_leaves(m)[0])
+        )[0]
+        per_offset.append(m)
     return {
         key: jnp.stack([m[key] for m in per_offset]) for key in per_offset[0]
     }

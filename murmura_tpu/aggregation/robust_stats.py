@@ -23,6 +23,9 @@ from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     candidate_indices,
+    circulant_candidate_map,
+    circulant_neighbor_distances,
+    circulant_weighted_sum,
 )
 
 
@@ -76,11 +79,12 @@ def make_coordinate_median(
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
         m = len(offsets) + 1
-        cand = jnp.stack(
-            [own] + [jnp.roll(bcast, -o, axis=0) for o in offsets]
-        )  # [m, N, P], all valid
-        ranked = jnp.sort(cand, axis=0)
-        new_flat = 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
+
+        def coord_median(cand):  # [m, N, c] -> [N, c], all candidates valid
+            ranked = jnp.sort(cand, axis=0)
+            return 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
+
+        new_flat = circulant_candidate_map(own, bcast, offsets, coord_median)
         return new_flat, state, {
             "num_candidates": jnp.full((n,), float(m), jnp.float32)
         }
@@ -136,11 +140,12 @@ def make_trimmed_mean(
         n = own.shape[0]
         m = len(offsets) + 1
         trim = int(beta * m)  # static: every node has exactly m candidates
-        cand = jnp.stack(
-            [own] + [jnp.roll(bcast, -o, axis=0) for o in offsets]
-        )  # [m, N, P]
-        ranked = jnp.sort(cand, axis=0)
-        new_flat = ranked[trim : m - trim].mean(axis=0)  # m-2*trim >= 1
+
+        def coord_trimmed(cand):  # [m, N, c] -> [N, c]
+            ranked = jnp.sort(cand, axis=0)
+            return ranked[trim : m - trim].mean(axis=0)  # m-2*trim >= 1
+
+        new_flat = circulant_candidate_map(own, bcast, offsets, coord_trimmed)
         return new_flat, state, {
             "num_candidates": jnp.full((n,), float(m), jnp.float32),
             "trimmed_per_side": jnp.full((n,), float(trim), jnp.float32),
@@ -250,21 +255,23 @@ def make_geometric_median(
         n = own.shape[0]
         k = len(offsets)
         own32 = own.astype(jnp.float32)
-        rolled = jnp.stack(
-            [jnp.roll(bcast, -o, axis=0) for o in offsets]
-        ).astype(jnp.float32)  # [k, N, P]
 
         def weighted_mean(w_self, w_k):
-            acc = w_self[:, None] * own32 + (w_k[:, :, None] * rolled).sum(0)
+            # circulant_weighted_sum promotes each w*roll product to f32
+            # (result_type with f32 weights) chunk-by-chunk — the same
+            # upcast-then-multiply the old [k, N, P] f32 stack did, without
+            # ever holding k rolled copies (the 256-node OOM class).
+            acc = w_self[:, None] * own32 + circulant_weighted_sum(
+                bcast, w_k, offsets
+            )
             tot = w_self + w_k.sum(axis=0)
             return acc / jnp.maximum(tot, 1e-30)[:, None]
 
         def distances(z):
-            # f32 reduces, same rationale as the dense path.
+            # f32 reduces, same rationale as the dense path; the neighbor
+            # distances ride the shared P-chunked kernel.
             d_self = jnp.sqrt(jnp.square(own32 - z).sum(axis=-1))  # [N]
-            d_k = jnp.sqrt(
-                jnp.square(rolled - z[None]).sum(axis=-1)
-            )  # [k, N]
+            d_k = circulant_neighbor_distances(z, bcast, offsets)  # [k, N]
             return d_self, d_k
 
         ones_k = jnp.ones((k, n), jnp.float32)

@@ -54,6 +54,62 @@ class TestPairwiseDistances:
         np.testing.assert_allclose(d, direct, rtol=0.05, atol=1e-4)
 
 
+class TestCirculantChunking:
+    """The P-chunked circulant kernels (base.py _CIRCULANT_CHUNK_BYTES —
+    the 256-node OOM fix) must reproduce the single-chunk computation."""
+
+    def _force_chunk(self, monkeypatch, nbytes):
+        from murmura_tpu.aggregation import base
+
+        monkeypatch.setattr(base, "_CIRCULANT_CHUNK_BYTES", nbytes)
+
+    def test_distances_match_unchunked(self, monkeypatch):
+        from murmura_tpu.aggregation.base import circulant_neighbor_distances
+
+        rng = np.random.default_rng(3)
+        own = jnp.asarray(rng.normal(size=(6, 101)), jnp.float32)
+        bcast = jnp.asarray(rng.normal(size=(6, 101)), jnp.float32)
+        offsets = [1, 2, 5]
+        ref = np.asarray(circulant_neighbor_distances(own, bcast, offsets))
+        # 6 nodes * 4 bytes * 7 -> chunk len 7: 14 full chunks + tail of 3.
+        self._force_chunk(monkeypatch, 6 * 4 * 7)
+        chunked = np.asarray(circulant_neighbor_distances(own, bcast, offsets))
+        np.testing.assert_allclose(chunked, ref, rtol=1e-6, atol=1e-6)
+
+    def test_weighted_sum_matches_unchunked(self, monkeypatch):
+        from murmura_tpu.aggregation.base import circulant_weighted_sum
+
+        rng = np.random.default_rng(4)
+        bcast = jnp.asarray(rng.normal(size=(5, 64)), jnp.float32)
+        w_k = jnp.asarray(rng.uniform(size=(2, 5)), jnp.float32)
+        offsets = [1, 4]
+        ref = np.asarray(circulant_weighted_sum(bcast, w_k, offsets))
+        self._force_chunk(monkeypatch, 5 * 4 * 9)  # chunk 9, tail 1
+        chunked = np.asarray(circulant_weighted_sum(bcast, w_k, offsets))
+        np.testing.assert_allclose(chunked, ref, rtol=1e-6, atol=1e-6)
+
+    def test_exact_chunk_divisor_no_tail(self, monkeypatch):
+        from murmura_tpu.aggregation.base import circulant_weighted_sum
+
+        rng = np.random.default_rng(5)
+        bcast = jnp.asarray(rng.normal(size=(4, 60)), jnp.float32)
+        w_k = jnp.asarray(rng.uniform(size=(1, 4)), jnp.float32)
+        ref = np.asarray(circulant_weighted_sum(bcast, w_k, [2]))
+        self._force_chunk(monkeypatch, 4 * 4 * 15)  # chunk 15 divides 60
+        chunked = np.asarray(circulant_weighted_sum(bcast, w_k, [2]))
+        np.testing.assert_allclose(chunked, ref, rtol=1e-6, atol=1e-6)
+
+    def test_bf16_states_f32_weights_dtype(self, monkeypatch):
+        from murmura_tpu.aggregation.base import circulant_weighted_sum
+
+        bcast = jnp.ones((4, 40), jnp.bfloat16)
+        w_k = jnp.ones((1, 4), jnp.float32) * 0.5
+        self._force_chunk(monkeypatch, 4 * 2 * 16)
+        out = circulant_weighted_sum(bcast, w_k, [1])
+        assert out.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(out), 0.5, atol=1e-6)
+
+
 class TestFedAvg:
     def test_masked_mean(self):
         """Ring node averages itself + its two neighbors (fedavg.py:19-42)."""

@@ -123,6 +123,35 @@ def test_ppermute_exchange_matches_allgather():
     )
 
 
+def test_ppermute_chunked_kernels_match_sharded_and_unsharded(monkeypatch):
+    """Forcing the P-chunked circulant kernels (the 256-node OOM fix,
+    base.py _CIRCULANT_CHUNK_BYTES) must not change training history —
+    on one device and with the node axis sharded over the 8-device mesh."""
+    from murmura_tpu.aggregation import base as agg_base
+
+    def cfg(num_devices):
+        c = _cfg("tpu")
+        c.topology.type = "k-regular"
+        c.topology.k = 4
+        c.tpu.exchange = "ppermute"
+        c.tpu.num_devices = num_devices
+        return c
+
+    ref = build_network_from_config(cfg(1)).train(rounds=3)
+    # MLP 24->32->4 => P = 24*32+32+32*4+4 = 964 floats; chunk len
+    # 1024 // (8 nodes * 4 bytes) = 32 -> 30 full chunks + tail.
+    monkeypatch.setattr(agg_base, "_CIRCULANT_CHUNK_BYTES", 1024)
+    chunked = build_network_from_config(cfg(1)).train(rounds=3)
+    sharded = build_network_from_config(cfg(8)).train(rounds=3)
+    for hist in (chunked, sharded):
+        np.testing.assert_allclose(
+            ref["mean_loss"], hist["mean_loss"], rtol=1e-4
+        )
+        np.testing.assert_allclose(
+            ref["mean_accuracy"], hist["mean_accuracy"], atol=1e-5
+        )
+
+
 def test_ppermute_exchange_rejects_noncirculant():
     import pytest as _pytest
 

@@ -254,9 +254,10 @@ def main():
         )
 
     # The north-star SCALE scenario (BASELINE.json: 256-node Krum FEMNIST):
-    # same flagship model at 256 nodes on this one chip, O(degree)
-    # circulant exchange + bf16 resident params (the documented large-N
-    # configuration).  TPU-only (CPU execution at this N is minutes/round)
+    # same flagship model at 256 nodes on this one chip, bf16 resident
+    # params, both exchange formulations measured (best reported — see the
+    # comment in the try block).  TPU-only (CPU execution at this N is
+    # minutes/round)
     # and optional — the headline is EMITTED FIRST so that even an
     # uninterruptible PJRT hang or an OOM kill here leaves a valid last
     # JSON line for the driver; on success the enriched line replaces it
@@ -265,17 +266,52 @@ def main():
         emit(None, None)
         return
     emit(None, "pending: 256-node run follows")
-    try:
-        ns = measure("bfloat16", num_nodes=256, exchange="ppermute")
-        north_star = {
+
+    def ns_payload(best_ns, ns_variants, ns_errors):
+        b_exch, b_ns = best_ns
+        return {
             "nodes": 256,
-            "exchange": "ppermute",
+            "exchange": b_exch,
             "param_dtype": "bfloat16",
-            "rounds_per_sec": round(ns["rounds_per_sec"], 3),
-            "compile_s": ns["compile_s"],
-            "round_ms": round(1e3 * ns["elapsed"] / timed_rounds, 2),
+            "rounds_per_sec": round(b_ns["rounds_per_sec"], 3),
+            "compile_s": b_ns["compile_s"],
+            "round_ms": round(1e3 * b_ns["elapsed"] / timed_rounds, 2),
+            "exchange_variants": dict(ns_variants),
+            "exchange_errors": ns_errors or None,
         }
-        emit(north_star, None)
+
+    try:
+        # Both exchange formulations: ppermute is the sharded-mesh (pod)
+        # configuration — its win is O(degree) communication volume over
+        # ICI, which a one-chip run cannot exhibit — while on a single
+        # chip the dense allgather Gram path wins (round-5 measurement:
+        # 2.14 vs 1.50 rounds/sec).  Report the best, record both; a
+        # failure in one variant (e.g. the pre-round-5 ppermute HBM OOM)
+        # must not lose the other's number.
+        ns_variants, ns_errors = {}, {}
+        best_ns = None
+        for exch in ("allgather", "ppermute"):
+            try:
+                ns = measure("bfloat16", num_nodes=256, exchange=exch)
+            except Exception as e:  # noqa: BLE001
+                ns_errors[exch] = f"{type(e).__name__}: {e}"[:200]
+                continue
+            ns_variants[exch] = round(ns["rounds_per_sec"], 3)
+            if best_ns is None or ns["rounds_per_sec"] > best_ns[1]["rounds_per_sec"]:
+                best_ns = (exch, ns)
+            # Emit the best-so-far after EVERY successful variant: an
+            # uninterruptible PJRT wedge or host OOM kill in the next
+            # variant would otherwise discard this one's number (only
+            # Python exceptions reach the except above; the driver reads
+            # the last line, so intermediate emits are free).
+            emit(ns_payload(best_ns, ns_variants, ns_errors), None)
+        if best_ns is None:
+            emit(None, "; ".join(f"{k}: {v}" for k, v in ns_errors.items())[:300])
+        elif ns_errors:
+            # The last in-loop emit predates a later variant's Python
+            # failure; re-emit so the final line carries the complete
+            # error record alongside the surviving number.
+            emit(ns_payload(best_ns, ns_variants, ns_errors), None)
     except Exception as e:
         emit(None, f"{type(e).__name__}: {e}"[:300])
 

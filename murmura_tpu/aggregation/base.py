@@ -58,6 +58,13 @@ class AggContext:
     num_classes: int = 0
     total_rounds: int = 1
     probe_cross: Optional[Dict[str, jnp.ndarray]] = None
+    # True when the round step runs with the node axis sharded over a mesh
+    # (tpu.num_devices > 1): circulant shift lowerings differ — jnp.roll
+    # becomes boundary collective-permutes (O(degree) communication, the
+    # point of tpu.exchange: ppermute) while a static-index gather would
+    # lower to an all-gather; on ONE device the roles reverse (roll's
+    # wrap-around slice pads up to 128x, a gather pads nothing).
+    node_axis_sharded: bool = False
 
 
 @dataclass(frozen=True)
@@ -178,6 +185,15 @@ def _p_chunked_map(arrays, chunk_fn, out_dtype, p: int, chunk: int):
     run under a fori_loop whose carry is the output buffer (XLA aliases
     while-loop carries in place, so the only full-size array is the output
     itself), and the remainder is a statically-shaped tail update.
+
+    A statically-unrolled formulation (chunks barrier-chained, output via
+    one concatenate) was measured WORSE on the 256-node program: XLA's
+    buffer assignment kept every chunk's slice + rolled temps in distinct
+    live allocations (40.4 GB vs this formulation's 17.2 GB).  The while
+    carry costs {0,1}-layout conversion copies at the loop boundary, but
+    that is the cheaper failure mode.  On a single device, very large
+    N*P circulant programs should prefer the dense allgather rules
+    anyway — see the geometric-median Gram path and PERFORMANCE.md.
     """
     n = arrays[0].shape[0]
     nfull = p // chunk
@@ -252,28 +268,38 @@ def circulant_neighbor_distances(
 
 
 def circulant_weighted_sum(
-    bcast: jnp.ndarray, w_k: jnp.ndarray, offsets
+    bcast: jnp.ndarray, w_k: jnp.ndarray, offsets, out_dtype=None
 ) -> jnp.ndarray:
     """[N, P] per-offset weighted neighbor sum: sum_o w_k[o, i] * bcast[(i+o) % N].
 
     The shared memory-safe kernel behind the circulant masked mean, the
-    fedavg roll path and evidential trust's weighted blend.  Large N*P runs
-    P-chunked with the output assembled via dynamic_update_slice on the
-    fori_loop carry (XLA aliases while-loop carries in place, so the only
-    full-size buffers are ``bcast`` and the output).
+    fedavg roll path, evidential trust's weighted blend and the Weiszfeld
+    recursion.  Large N*P runs P-chunked with the output assembled via
+    dynamic_update_slice on the fori_loop carry (XLA aliases while-loop
+    carries in place, so the only full-size buffers are ``bcast`` and the
+    output).
+
+    ``out_dtype`` narrows the OUTPUT buffer only — per-chunk accumulation
+    still runs at the promoted precision (f32 for f32 weights over bf16
+    states) and the cast happens once per chunk.  Callers that iterate on
+    the result (geometric median) pass the resident param dtype here so a
+    bf16 256-node program does not materialize f32 [N, P] buffers — the
+    6.3 GB-per-copy OOM class.
     """
     n, p = bcast.shape
-    out_dtype = jnp.result_type(bcast.dtype, w_k.dtype)
+    acc_dtype = jnp.result_type(bcast.dtype, w_k.dtype)
+    if out_dtype is None:
+        out_dtype = acc_dtype
 
     def chunk_sum(bc):
-        acc = jnp.zeros(bc.shape, out_dtype)
+        acc = jnp.zeros(bc.shape, acc_dtype)
         for idx, o in enumerate(offsets):
             acc = acc + w_k[idx][:, None] * jnp.roll(bc, -o, axis=0)
         return acc
 
     chunk = _p_chunk_len(n, p, bcast.dtype.itemsize)
     if chunk >= p:
-        return chunk_sum(bcast)
+        return chunk_sum(bcast).astype(out_dtype)
     return _p_chunked_map([bcast], chunk_sum, out_dtype, p, chunk)
 
 

@@ -13,6 +13,7 @@ from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from murmura_tpu.aggregation.base import AggContext
 
@@ -84,11 +85,23 @@ def circulant_probe_eval(
     # node-axis sharding); ordering is imposed by gating each roll's input
     # on the previous offset's metrics via optimization_barrier.  The probe
     # forwards dominate the cost, so losing cross-offset parallelism is
-    # free.
+    # free.  The shift op is backend-dependent (ctx.node_axis_sharded):
+    # on ONE device a static-index row gather — jnp.roll's slice+concat
+    # lowering pads the [o, P] wrap-around slice up to 128x (1.56 GB of
+    # pure padding per offset at 256 nodes, the UBAR OOM) while a
+    # constant-index gather pads nothing; on a SHARDED node axis jnp.roll
+    # — it lowers to boundary collective-permutes (O(degree) ICI traffic)
+    # where the gather would lower to a full all-gather (verified on an
+    # 8-device mesh HLO: roll = 6 collective-permutes / 0 all-gathers,
+    # take = 0 / 3).
     per_offset = []
     gate = bcast
     for o in offsets:
-        rolled = jnp.roll(gate, -o, axis=0)
+        if ctx.node_axis_sharded:
+            rolled = jnp.roll(gate, -o, axis=0)
+        else:
+            idx = jnp.asarray(np.roll(np.arange(gate.shape[0]), -o))
+            rolled = jnp.take(gate, idx, axis=0)
         m = jax.vmap(eval_one)(rolled, ctx.probe_x, ctx.probe_y, ctx.probe_mask)
         gate = jax.lax.optimization_barrier(
             (bcast, jax.tree_util.tree_leaves(m)[0])

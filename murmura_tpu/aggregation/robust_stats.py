@@ -26,6 +26,7 @@ from murmura_tpu.aggregation.base import (
     circulant_candidate_map,
     circulant_neighbor_distances,
     circulant_weighted_sum,
+    pairwise_l2_distances,
 )
 
 
@@ -206,41 +207,75 @@ def make_geometric_median(
 
         n = own.shape[0]
         m_cap = n if mc is None else min(mc, n)
-        cand, valid = _candidate_tensor(own, bcast, adj, m_cap)
-        vmask = valid.astype(jnp.float32)  # [N, m]
-        cnt = vmask.sum(axis=1)  # [N] >= 1 (self always valid)
-        c32 = cand.astype(jnp.float32)
+        # Gram-masked formulation: candidates never materialize as an
+        # [N, m, P] tensor (31.5 GB at 256 nodes — un-runnable on a v5e).
+        # Node i's candidates are {own_i} + its masked neighbors, so every
+        # Weiszfeld step is one [N, N] distance matrix (one Gram matmul,
+        # pairwise_l2_distances) + one [N, N] @ [N, P] weighted mean —
+        # O(N^2 + N.P) memory at every N, and the matmuls read the big
+        # tensors exactly once per iteration (memory-optimal on a single
+        # device; the circulant path below serves sharded meshes).
+        # ``max_candidates`` semantics are preserved by masking weights to
+        # the same deterministic candidate set the capped tensor used.
+        if m_cap < n:
+            ci, cv = candidate_indices(adj, m_cap)  # [N, m] each
+            nb_mask = (
+                jnp.zeros((n, n), jnp.float32)
+                .at[jnp.arange(n)[:, None], ci]
+                .max(cv.astype(jnp.float32))
+            )
+            nb_mask = nb_mask * (1.0 - jnp.eye(n))  # self handled apart
+        else:
+            nb_mask = adj.astype(jnp.float32)
+        cnt = 1.0 + nb_mask.sum(axis=1)  # [N], self always a candidate
 
-        def weighted_mean(w):
-            return (w[:, :, None] * c32).sum(axis=1) / jnp.maximum(
-                w.sum(axis=1), 1e-30
-            )[:, None]
+        def weighted_mean(w_self, w_nb):
+            # w_nb rows are masked; f32 weights, f32 accumulation
+            # (preferred_element_type), bf16/f32 state operands as stored.
+            acc = w_self[:, None] * own.astype(jnp.float32) + jnp.dot(
+                w_nb, bcast, preferred_element_type=jnp.float32
+            )
+            tot = w_self + w_nb.sum(axis=1)
+            return (acc / jnp.maximum(tot, 1e-30)[:, None]).astype(own.dtype)
 
         def distances(z):
-            # f32 reduce regardless of param dtype: a bf16 accumulation
-            # over P terms would quantize the distances the reweighting
-            # ranks on (same hazard pairwise_l2_distances guards against).
-            return jnp.sqrt(
-                jnp.square(c32 - z[:, None, :]).sum(axis=-1)
-            )  # [N, m]
+            # d_self elementwise (f32 reduce — a bf16 accumulation over P
+            # terms would quantize the distances the reweighting ranks on);
+            # neighbor distances via one centered Gram matmul.
+            d_self = jnp.sqrt(
+                jnp.square((own - z).astype(jnp.float32)).sum(axis=-1)
+            )  # [N]
+            # Argument order matters inside the loop: pairwise centers by
+            # the FIRST argument's mean, so passing the loop-invariant
+            # bcast first lets XLA hoist its centered copy out of the
+            # Weiszfeld iterations (z's cluster stays near bcast's, so the
+            # cancellation guard is equally served); [j, i] -> transpose.
+            d_nb = pairwise_l2_distances(bcast, z).T  # [N, N]
+            return d_self, d_nb
+
+        ones_n = jnp.ones((n,), jnp.float32)
+        z0 = weighted_mean(ones_n, nb_mask)
 
         def body(_, z):
-            w = vmask / jnp.maximum(distances(z), nu)
-            return weighted_mean(w)
+            d_self, d_nb = distances(z)
+            return weighted_mean(
+                1.0 / jnp.maximum(d_self, nu),
+                nb_mask / jnp.maximum(d_nb, nu),
+            )
 
-        z = lax.fori_loop(0, iters, body, weighted_mean(vmask))
-        final_w = vmask / jnp.maximum(distances(z), nu)
-        share = final_w / jnp.maximum(
-            final_w.sum(axis=1, keepdims=True), 1e-30
-        )
+        z = lax.fori_loop(0, iters, body, z0)
+        d_self, d_nb = distances(z)
+        w_self = 1.0 / jnp.maximum(d_self, nu)
+        w_nb = nb_mask / jnp.maximum(d_nb, nu)
+        tot = jnp.maximum(w_self + w_nb.sum(axis=1), 1e-30)
         stats = {
             "num_candidates": cnt,
             # Attack telemetry: how concentrated the final Weiszfeld
             # weights are.  A clean network keeps shares near 1/cnt; an
             # outlier-heavy neighborhood pushes the max share up as honest
             # candidates cluster and outliers are downweighted.
-            "max_weight_share": share.max(axis=1),
-            "mean_dist_to_gm": (distances(z) * vmask).sum(axis=1)
+            "max_weight_share": jnp.maximum(w_self, w_nb.max(axis=1)) / tot,
+            "mean_dist_to_gm": (d_self + (d_nb * nb_mask).sum(axis=1))
             / jnp.maximum(cnt, 1.0),
         }
         return z.astype(own.dtype), state, stats
@@ -254,23 +289,30 @@ def make_geometric_median(
 
         n = own.shape[0]
         k = len(offsets)
-        own32 = own.astype(jnp.float32)
 
         def weighted_mean(w_self, w_k):
             # circulant_weighted_sum promotes each w*roll product to f32
-            # (result_type with f32 weights) chunk-by-chunk — the same
-            # upcast-then-multiply the old [k, N, P] f32 stack did, without
-            # ever holding k rolled copies (the 256-node OOM class).
-            acc = w_self[:, None] * own32 + circulant_weighted_sum(
-                bcast, w_k, offsets
+            # (f32 weights) chunk-by-chunk — the same upcast-then-multiply
+            # the old [k, N, P] f32 stack did, without ever holding k
+            # rolled copies.  The iterate is STORED in the resident param
+            # dtype: all f32 [N, P] intermediates here live only inside
+            # fused elementwise chains (registers), never as buffers —
+            # a bf16 256-node program previously materialized three
+            # 6.3 GB f32 copies (own upcast + two remat copies of z) and
+            # OOM'd at 32.7 GB.
+            acc = w_self[:, None] * own + circulant_weighted_sum(
+                bcast, w_k, offsets, out_dtype=own.dtype
             )
             tot = w_self + w_k.sum(axis=0)
-            return acc / jnp.maximum(tot, 1e-30)[:, None]
+            return (acc / jnp.maximum(tot, 1e-30)[:, None]).astype(own.dtype)
 
         def distances(z):
-            # f32 reduces, same rationale as the dense path; the neighbor
-            # distances ride the shared P-chunked kernel.
-            d_self = jnp.sqrt(jnp.square(own32 - z).sum(axis=-1))  # [N]
+            # f32 reduces, same rationale as the dense path (XLA fuses the
+            # per-element upcast into the reduce); the neighbor distances
+            # ride the shared P-chunked kernel.
+            d_self = jnp.sqrt(
+                jnp.square((own - z).astype(jnp.float32)).sum(axis=-1)
+            )  # [N]
             d_k = circulant_neighbor_distances(z, bcast, offsets)  # [k, N]
             return d_self, d_k
 

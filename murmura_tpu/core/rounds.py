@@ -102,6 +102,7 @@ def build_round_program(
     eval_chunk: int = 1024,
     dmtt: Optional[DMTTParams] = None,
     param_dtype: Optional[str] = None,
+    node_axis_sharded: bool = False,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -283,6 +284,7 @@ def build_round_program(
         evidential=evidential,
         num_classes=num_classes,
         total_rounds=total_rounds,
+        node_axis_sharded=node_axis_sharded,
     )
 
     attack_apply = attack.apply if attack is not None else None
@@ -315,6 +317,7 @@ def build_round_program(
             evidential=ctx.evidential,
             num_classes=ctx.num_classes,
             total_rounds=ctx.total_rounds,
+            node_axis_sharded=ctx.node_axis_sharded,
         )
 
         # 2b. DMTT: claim exchange + trust update gate the exchange mask

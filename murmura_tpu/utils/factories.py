@@ -230,6 +230,24 @@ def apply_compilation_cache(config: Config) -> None:
         )
 
 
+def _node_axis_sharded(config: Config, mesh=None) -> bool:
+    """Whether the round step will run with the node axis sharded over a
+    mesh — selects circulant shift lowerings (AggContext.node_axis_sharded).
+    An explicitly passed mesh is authoritative (it IS the thing this flag
+    describes); otherwise ``tpu.num_devices: null`` means "all available",
+    so the device count is only known at build time."""
+    if config.backend != "tpu":
+        return False
+    if mesh is not None:
+        return mesh.size > 1
+    nd = config.tpu.num_devices
+    if nd is not None:
+        return nd > 1
+    import jax
+
+    return jax.device_count() > 1
+
+
 def build_network_from_config(config: Config, mesh=None) -> Network:
     """Full wiring: data + model + aggregator + attack -> Network."""
     if config.backend == "tpu" and config.tpu.multihost and mesh is None:
@@ -343,6 +361,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         lambda_weight=0.1,
         dmtt=dmtt,
         param_dtype=resolved_param_dtype(config),
+        node_axis_sharded=_node_axis_sharded(config, mesh),
     )
 
     if config.backend == "tpu" and mesh is None:

@@ -152,6 +152,32 @@ def test_ppermute_chunked_kernels_match_sharded_and_unsharded(monkeypatch):
         )
 
 
+def test_node_axis_sharded_flag_resolution():
+    """AggContext.node_axis_sharded selects circulant shift lowerings
+    (probe.py): an explicit mesh is authoritative, else tpu.num_devices."""
+    from murmura_tpu.utils.factories import _node_axis_sharded
+
+    c1 = _cfg("tpu")
+    c1.tpu.num_devices = 1
+    assert _node_axis_sharded(c1) is False
+    c8 = _cfg("tpu")
+    c8.tpu.num_devices = 8
+    assert _node_axis_sharded(c8) is True
+    assert _node_axis_sharded(_cfg("simulation")) is False
+
+    # Explicit mesh wins over config (a subset mesh on a multi-device host
+    # must not pick the sharded lowering).
+    import jax
+    from jax.sharding import Mesh
+
+    cnull = _cfg("tpu")
+    cnull.tpu.num_devices = None
+    single = Mesh(np.array(jax.devices()[:1]), ("nodes",))
+    assert _node_axis_sharded(cnull, single) is False
+    full = Mesh(np.array(jax.devices()), ("nodes",))
+    assert _node_axis_sharded(cnull, full) is (len(jax.devices()) > 1)
+
+
 def test_ppermute_exchange_rejects_noncirculant():
     import pytest as _pytest
 

@@ -303,6 +303,28 @@ def circulant_weighted_sum(
     return _p_chunked_map([bcast], chunk_sum, out_dtype, p, chunk)
 
 
+def candidate_chunk_dispatch(own, bcast, chunk_apply, stack_height: int):
+    """Shared P-chunking dispatch for candidate-stack reductions.
+
+    ``chunk_apply(own_chunk, bcast_chunk) -> [N, c]`` must be
+    coordinate-wise along the last axis.  The budget is scaled by
+    ``stack_height`` (how many [N, c]-sized copies the stack materializes
+    per chunk); small N*P runs the exact single-chunk computation.  Both
+    the circulant and the dense candidate maps dispatch through here so
+    the OOM-budget logic lives in one place.
+    """
+    n, p = bcast.shape
+    chunk = _p_chunk_len(n * stack_height, p, bcast.dtype.itemsize)
+    if chunk >= p:
+        return chunk_apply(own, bcast)
+    out_dtype = jax.eval_shape(
+        chunk_apply,
+        jax.ShapeDtypeStruct((n, 1), own.dtype),
+        jax.ShapeDtypeStruct((n, 1), bcast.dtype),
+    ).dtype
+    return _p_chunked_map([own, bcast], chunk_apply, out_dtype, p, chunk)
+
+
 def circulant_candidate_map(own, bcast, offsets, fn) -> jnp.ndarray:
     """Apply a coordinate-wise reduction over the circulant candidate stack.
 
@@ -314,21 +336,10 @@ def circulant_candidate_map(own, bcast, offsets, fn) -> jnp.ndarray:
     trimmed-mean circulant paths never materialize the full [m, N, P]
     tensor (the same OOM class ``_CIRCULANT_CHUNK_BYTES`` exists for).
     """
-    n, p = bcast.shape
-    m = len(offsets) + 1
-
     def chunk_apply(oc, bc):
         return fn(jnp.stack([oc] + [jnp.roll(bc, -o, axis=0) for o in offsets]))
 
-    chunk = _p_chunk_len(n * m, p, bcast.dtype.itemsize)
-    if chunk >= p:
-        return chunk_apply(own, bcast)
-    out_dtype = jax.eval_shape(
-        chunk_apply,
-        jax.ShapeDtypeStruct((n, 1), own.dtype),
-        jax.ShapeDtypeStruct((n, 1), bcast.dtype),
-    ).dtype
-    return _p_chunked_map([own, bcast], chunk_apply, out_dtype, p, chunk)
+    return candidate_chunk_dispatch(own, bcast, chunk_apply, len(offsets) + 1)
 
 
 def circulant_masked_mean(

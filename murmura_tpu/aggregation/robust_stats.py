@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    candidate_chunk_dispatch,
     candidate_indices,
     circulant_candidate_map,
     circulant_neighbor_distances,
@@ -30,16 +31,35 @@ from murmura_tpu.aggregation.base import (
 )
 
 
-def _candidate_tensor(own, bcast, adj, m_cap):
-    """Gathered [N, m, P] candidate states plus the [N, m] validity mask
-    (ordering: base.candidate_indices, shared with Krum's candidate
-    blocks).  The self candidate takes the node's own true state."""
-    n = own.shape[0]
+def _dense_candidate_map(own, bcast, adj, m_cap, fn):
+    """Apply a coordinate-wise reduction over the gathered candidate stack.
+
+    ``fn`` maps (cand [N, m, c], valid [N, m]) -> [N, c] and must be
+    coordinate-wise along the last axis (candidate ordering:
+    base.candidate_indices, shared with Krum's candidate blocks; the self
+    candidate takes the node's own true state).  Large N*m*P runs
+    P-chunked on the shared machinery so the dense median/trimmed-mean
+    never materialize the full [N, m, P] gather — 15.7 GB at 256 nodes
+    bf16 with m = 5, the same OOM class the circulant candidate map
+    chunks against.
+
+    Returns:
+        ([N, P] result, valid [N, m]) — valid is also returned so callers
+        compute count stats without re-deriving the candidate set.
+    """
+    n = bcast.shape[0]
     cand_idx, valid = candidate_indices(adj, m_cap)
-    cand = bcast[cand_idx]  # [N, m, P]
     is_self = cand_idx == jnp.arange(n)[:, None]
-    cand = jnp.where(is_self[:, :, None], own[:, None, :], cand)
-    return cand, valid
+
+    def chunk_apply(oc, bc):
+        cand = bc[cand_idx]  # [N, m, c]
+        cand = jnp.where(is_self[:, :, None], oc[:, None, :], cand)
+        return fn(cand, valid)
+
+    result = candidate_chunk_dispatch(
+        own, bcast, chunk_apply, int(cand_idx.shape[1])
+    )
+    return result, valid
 
 
 def make_coordinate_median(
@@ -64,17 +84,25 @@ def make_coordinate_median(
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
         m_cap = n if mc is None else min(mc, n)
-        cand, valid = _candidate_tensor(own, bcast, adj, m_cap)
-        cnt = valid.sum(axis=1)  # [N] >= 1 (self always valid)
-        # Invalid candidates are +inf-padded and sort to the END, so the
-        # median indices (cnt-1)//2 and cnt//2 address only the first cnt
-        # (valid) rows.
-        ranked = jnp.sort(
-            jnp.where(valid[:, :, None], cand, jnp.inf), axis=1
+
+        def coord_median(cand, valid):
+            cnt = valid.sum(axis=1)
+            # Invalid candidates are +inf-padded and sort to the END, so
+            # the median indices (cnt-1)//2 and cnt//2 address only the
+            # first cnt (valid) rows.
+            ranked = jnp.sort(
+                jnp.where(valid[:, :, None], cand, jnp.inf), axis=1
+            )
+            lo = jnp.take_along_axis(
+                ranked, ((cnt - 1) // 2)[:, None, None], axis=1
+            )
+            hi = jnp.take_along_axis(ranked, (cnt // 2)[:, None, None], axis=1)
+            return (0.5 * (lo + hi))[:, 0, :]
+
+        new_flat, valid = _dense_candidate_map(
+            own, bcast, adj, m_cap, coord_median
         )
-        lo = jnp.take_along_axis(ranked, ((cnt - 1) // 2)[:, None, None], axis=1)
-        hi = jnp.take_along_axis(ranked, (cnt // 2)[:, None, None], axis=1)
-        new_flat = (0.5 * (lo + hi))[:, 0, :]
+        cnt = valid.sum(axis=1)  # [N] >= 1 (self always valid)
         return new_flat, state, {"num_candidates": cnt.astype(jnp.float32)}
 
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
@@ -121,17 +149,26 @@ def make_trimmed_mean(
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
         m_cap = n if mc is None else min(mc, n)
-        cand, valid = _candidate_tensor(own, bcast, adj, m_cap)
-        cnt = valid.sum(axis=1)  # [N]
-        trim = jnp.floor(beta * cnt).astype(cnt.dtype)  # [N]
-        ranked = jnp.sort(
-            jnp.where(valid[:, :, None], cand, jnp.inf), axis=1
+
+        def coord_trimmed(cand, valid):
+            cnt = valid.sum(axis=1)  # [N]
+            trim = jnp.floor(beta * cnt).astype(cnt.dtype)  # [N]
+            ranked = jnp.sort(
+                jnp.where(valid[:, :, None], cand, jnp.inf), axis=1
+            )
+            pos = jnp.arange(valid.shape[1])[None, :]  # [1, m]
+            keep = (pos >= trim[:, None]) & (
+                pos < (cnt - trim)[:, None]
+            )  # [N, m]
+            kept = jnp.where(keep[:, :, None], ranked, 0.0).sum(axis=1)
+            denom = jnp.maximum(cnt - 2 * trim, 1)[:, None].astype(own.dtype)
+            return kept / denom
+
+        new_flat, valid = _dense_candidate_map(
+            own, bcast, adj, m_cap, coord_trimmed
         )
-        pos = jnp.arange(m_cap)[None, :]  # [1, m]
-        keep = (pos >= trim[:, None]) & (pos < (cnt - trim)[:, None])  # [N, m]
-        kept = jnp.where(keep[:, :, None], ranked, 0.0).sum(axis=1)
-        denom = jnp.maximum(cnt - 2 * trim, 1)[:, None].astype(own.dtype)
-        new_flat = kept / denom
+        cnt = valid.sum(axis=1)
+        trim = jnp.floor(beta * cnt).astype(cnt.dtype)
         return new_flat, state, {
             "num_candidates": cnt.astype(jnp.float32),
             "trimmed_per_side": trim.astype(jnp.float32),

@@ -99,6 +99,30 @@ class TestCirculantChunking:
         chunked = np.asarray(circulant_weighted_sum(bcast, w_k, [2]))
         np.testing.assert_allclose(chunked, ref, rtol=1e-6, atol=1e-6)
 
+    def test_dense_median_trimmed_match_unchunked(self, monkeypatch):
+        """The P-chunked dense candidate map (_dense_candidate_map — the
+        15.7 GB [N, m, P] gather fix) must reproduce the single-chunk
+        result for both coordinate-wise rules on an irregular graph."""
+        rng = np.random.default_rng(6)
+        own = jnp.asarray(rng.normal(size=(6, 53)), jnp.float32)
+        bcast = jnp.asarray(rng.normal(size=(6, 53)), jnp.float32)
+        adj = _ring_adj(6)
+        for algo, params in [("median", {}), ("trimmed_mean", {"trim_ratio": 0.34})]:
+            agg = build_aggregator(algo, params)
+            ref, _, ref_stats = _run(agg, own, adj, bcast=bcast)
+            # m_cap defaults to n=6, so chunk = 720 // (6*6*4) = 5 -> 10
+            # full chunks + tail 3 over P=53.
+            self._force_chunk(monkeypatch, 6 * 3 * 4 * 10)
+            chunked, _, ch_stats = _run(agg, own, adj, bcast=bcast)
+            monkeypatch.undo()
+            np.testing.assert_allclose(
+                np.asarray(chunked), np.asarray(ref), rtol=1e-6, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(ch_stats["num_candidates"]),
+                np.asarray(ref_stats["num_candidates"]),
+            )
+
     def test_bf16_states_f32_weights_dtype(self, monkeypatch):
         from murmura_tpu.aggregation.base import circulant_weighted_sum
 

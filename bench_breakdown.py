@@ -84,22 +84,29 @@ def _timed_eval(ev, params, d, k1=5, k2=45):
     return (t2 - t1) / (k2 - k1)
 
 
-FLAGSHIP_CFG = {
-    "experiment": {"name": "breakdown", "seed": 7, "rounds": 10},
-    "topology": {"type": "k-regular", "num_nodes": 20, "k": 4},
-    "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
-    "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
-                "params": {"noise_std": 10.0}},
-    "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
-    "data": {
-        "adapter": "synthetic",
-        "params": {"num_samples": 160 * 20, "input_shape": [28, 28, 1],
-                    "num_classes": 62},
-    },
-    "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
-    "backend": "tpu",
-    "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
-}
+def flagship_cfg(num_nodes: int = 20) -> dict:
+    """The headline scenario at any scale; param_dtype stays on the auto
+    default (factories.resolved_param_dtype: bf16 from 64 nodes up), so
+    --nodes 256 measures the same configuration the north-star runs."""
+    return {
+        "experiment": {"name": "breakdown", "seed": 7, "rounds": 10},
+        "topology": {"type": "k-regular", "num_nodes": num_nodes, "k": 4},
+        "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+        "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
+                    "params": {"noise_std": 10.0}},
+        "training": {"local_epochs": 1, "batch_size": 32, "lr": 0.05},
+        "data": {
+            "adapter": "synthetic",
+            "params": {"num_samples": 160 * num_nodes,
+                        "input_shape": [28, 28, 1], "num_classes": 62},
+        },
+        "model": {"factory": "examples.leaf.LEAFFEMNISTModel", "params": {}},
+        "backend": "tpu",
+        "tpu": {"num_devices": 1, "compute_dtype": "bfloat16"},
+    }
+
+
+FLAGSHIP_CFG = flagship_cfg()
 
 # The probe-heavy scenario: evidential_trust on a 10-node fully-connected
 # UCI-HAR-shaped network — every node cross-evaluates every broadcast state
@@ -182,7 +189,13 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="tiny shapes + short chains, print-only: "
                          "correctness check of every segment program")
-    SMOKE = ap.parse_args().smoke
+    ap.add_argument("--nodes", type=int, default=20,
+                    help="flagship scenario scale (256 = the north-star "
+                         "shape; writes bench_breakdown_<N>node.json and "
+                         "skips the 10-node probe scenario)")
+    args_ns = ap.parse_args()
+    SMOKE = args_ns.smoke
+    nodes = args_ns.nodes
 
     results = {}
     adj = None
@@ -192,9 +205,9 @@ def main():
         ("passthrough_e1", "passthrough_bcast", 1),
         ("krum_e1", "krum", 1),
     ):
-        program, attack = build(algo, epochs)
+        program, attack = build(algo, epochs, raw_cfg=flagship_cfg(nodes))
         if adj is None:
-            topo = create_topology("k-regular", num_nodes=20, k=4, seed=12345)
+            topo = create_topology("k-regular", num_nodes=nodes, k=4, seed=12345)
             adj = jnp.asarray(topo.mask())
             comp = jnp.asarray(attack.compromised.astype("float32"))
         step = jax.jit(program.train_step)
@@ -228,6 +241,25 @@ def main():
         "eval_ms": results["eval"]["ms"],
         "full_round_ms": results["krum_e1"]["ms"],
     }
+
+    if nodes != 20:
+        # Scale runs measure only the flagship segments; the probe
+        # scenario is scale-independent (its own 10-node config).
+        blob = {
+            "device_kind": jax.devices()[0].device_kind,
+            "backend": jax.default_backend(),
+            "num_nodes": nodes,
+            "segments": seg,
+            "raw": results,
+        }
+        if SMOKE:
+            blob["smoke"] = True
+        out = f"bench_breakdown_{nodes}node.json"
+        Path(__file__).with_name(out).write_text(
+            json.dumps(blob, indent=2) + "\n"
+        )
+        print(json.dumps(blob))
+        return
 
     # Probe-heavy scenario: the same passthrough-vs-full difference
     # isolates the N x N cross-eval + trust update (the design's biggest

@@ -260,3 +260,36 @@ def test_conv_impl_im2col_config_path_matches_direct():
     np.testing.assert_allclose(
         hist_direct["mean_loss"], hist_gemm["mean_loss"], rtol=1e-3
     )
+
+
+@pytest.mark.slow
+def test_64node_rules_scale_smoke(monkeypatch):
+    """Structural scale coverage on CPU: 64 nodes crosses the bf16
+    auto-default boundary (factories.resolved_param_dtype) and, with the
+    chunk budget forced down, exercises the P-chunked circulant/dense
+    kernels inside a full round program — the code paths the 256-node
+    chip runs take, minus the chip."""
+    from murmura_tpu.aggregation import base as agg_base
+
+    # Tiny model keeps this a smoke test; the forced budget still splits
+    # its P into multiple chunks.
+    monkeypatch.setattr(agg_base, "_CIRCULANT_CHUNK_BYTES", 64 * 1024)
+
+    for algo, params, exchange in [
+        ("krum", {"num_compromised": 1}, "ppermute"),
+        ("geometric_median", {}, "allgather"),
+        ("median", {}, "allgather"),
+        ("trimmed_mean", {"trim_ratio": 0.2}, "ppermute"),
+    ]:
+        c = _cfg("tpu")
+        c.topology.type = "k-regular"
+        c.topology.k = 4
+        c.topology.num_nodes = 64
+        c.data.params["num_samples"] = 64 * 20
+        c.aggregation.algorithm = algo
+        c.aggregation.params = dict(params)
+        c.tpu.exchange = exchange
+        c.tpu.compute_dtype = "float32"  # CPU: bf16 matmuls are emulated
+        hist = build_network_from_config(c).train(rounds=2)
+        assert len(hist["round"]) == 2
+        assert np.isfinite(hist["mean_loss"]).all(), (algo, exchange)

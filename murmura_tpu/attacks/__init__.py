@@ -6,6 +6,7 @@ from murmura_tpu.attacks.directed import make_directed_deviation_attack
 from murmura_tpu.attacks.topology_liar import make_topology_liar_attack, false_claims
 from murmura_tpu.attacks.alie import make_alie_attack
 from murmura_tpu.attacks.ipm import make_ipm_attack
+from murmura_tpu.attacks.label_flip import make_label_flip, poison_labels
 
 ATTACKS = {
     "gaussian": make_gaussian_attack,
@@ -13,6 +14,7 @@ ATTACKS = {
     "topology_liar": make_topology_liar_attack,
     "alie": make_alie_attack,
     "ipm": make_ipm_attack,
+    "label_flip": make_label_flip,
 }
 
 __all__ = [
@@ -23,6 +25,8 @@ __all__ = [
     "make_topology_liar_attack",
     "make_alie_attack",
     "make_ipm_attack",
+    "make_label_flip",
+    "poison_labels",
     "false_claims",
     "ATTACKS",
 ]

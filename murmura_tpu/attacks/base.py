@@ -57,6 +57,17 @@ class Attack:
     ]
     # DMTT topology-liar claims hook (None for model-only attacks)
     claims_fn: Optional[Callable] = field(default=None)
+    # Data-poisoning attacks (label_flip) need their compromised nodes to
+    # RUN local SGD — the poison propagates through honest-looking
+    # gradients — where every model-state attack keeps them frozen
+    # (reference: murmura/core/network.py:99-101).  The round step keys
+    # its training mask off this flag.
+    trains_locally: bool = False
+    # Build-time data transform for poisoning attacks:
+    # (y [N, S], sample_mask [N, S], num_classes) -> y'.  Closes over the
+    # attack's own compromised set / fraction / seed so the factories
+    # never re-parse attack params (single source of truth).
+    data_poison_fn: Optional[Callable] = field(default=None)
 
     def is_compromised(self, node_id: int) -> bool:
         return bool(self.compromised[node_id])

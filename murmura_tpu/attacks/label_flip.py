@@ -1,0 +1,93 @@
+"""Label-flipping data poisoning — beyond-parity threat model #3.
+
+No reference counterpart (murmura's three attacks all perturb the
+*broadcast model states*; murmura/attacks/).  Label flipping poisons the
+TRAINING DATA of compromised nodes instead: their local SGD then produces
+honest-looking parameter updates whose statistics sit inside the benign
+distribution, so distance-based Byzantine filters (Krum, BALANCE,
+trimmed mean) have nothing to reject — the canonical argument for why
+robust aggregation alone is not a data-poisoning defense (Tolpegin et
+al. 2020, "Data Poisoning Attacks Against Federated Learning Systems").
+
+Mechanics:
+
+- the broadcast transform is the identity (states pass through exactly);
+- compromised nodes are NOT frozen during local training
+  (``Attack.trains_locally``) — the poison rides their gradients;
+- the flip itself happens once at build time (factories): a seeded
+  ``flip_fraction`` of each compromised node's training labels is rotated
+  ``y -> (y + 1) % num_classes`` (deterministic offset flip, the standard
+  untargeted variant; eval splits stay clean so accuracy measures real
+  damage, not mislabeled tests).
+"""
+
+from typing import Optional
+
+import numpy as np
+
+from murmura_tpu.attacks.base import Attack, select_compromised
+
+
+def poison_labels(
+    y: np.ndarray,
+    sample_mask: np.ndarray,
+    compromised: np.ndarray,
+    num_classes: int,
+    flip_fraction: float = 1.0,
+    seed: int = 42,
+) -> np.ndarray:
+    """Rotated-label copy of ``y`` on the compromised rows.
+
+    Args:
+        y: [N, S] int labels (padded positions ignored via sample_mask).
+        sample_mask: [N, S] 1.0 where the sample is real.
+        compromised: [N] bool.
+        flip_fraction: fraction of each compromised node's REAL samples
+            flipped (seeded choice without replacement).
+    """
+    if not 0.0 < flip_fraction <= 1.0:
+        raise ValueError(
+            f"flip_fraction must be in (0, 1], got {flip_fraction}"
+        )
+    out = np.array(y, copy=True)
+    rng = np.random.default_rng(seed)
+    for i in np.flatnonzero(compromised):
+        real = np.flatnonzero(np.asarray(sample_mask[i]) > 0)
+        if real.size == 0:
+            continue
+        k = max(1, int(round(flip_fraction * real.size)))
+        chosen = rng.choice(real, size=min(k, real.size), replace=False)
+        out[i, chosen] = (out[i, chosen] + 1) % num_classes
+    return out
+
+
+def make_label_flip(
+    num_nodes: int,
+    attack_percentage: float,
+    flip_fraction: float = 1.0,
+    seed: int = 42,
+    **_params,
+) -> Attack:
+    if not 0.0 < flip_fraction <= 1.0:
+        raise ValueError(
+            f"flip_fraction must be in (0, 1], got {flip_fraction}"
+        )
+    compromised = select_compromised(num_nodes, attack_percentage, seed)
+
+    def apply(flat, compromised_mask, key, round_idx):
+        # Identity: the poison is in the data, not the broadcast states.
+        return flat
+
+    def data_poison_fn(y, sample_mask, num_classes):
+        return poison_labels(
+            y, sample_mask, compromised, num_classes,
+            flip_fraction=flip_fraction, seed=seed,
+        )
+
+    return Attack(
+        name="label_flip",
+        compromised=compromised,
+        apply=apply,
+        trains_locally=True,
+        data_poison_fn=data_poison_fn,
+    )

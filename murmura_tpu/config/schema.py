@@ -54,7 +54,10 @@ class AttackConfig(_Strict):
     """Byzantine attack scenario (reference: murmura/config/schema.py:84-94)."""
 
     enabled: bool = Field(default=False, description="Enable Byzantine attacks")
-    type: Optional[Literal["gaussian", "directed_deviation", "topology_liar", "alie", "ipm"]] = Field(
+    type: Optional[Literal[
+        "gaussian", "directed_deviation", "topology_liar", "alie", "ipm",
+        "label_flip",
+    ]] = Field(
         default=None, description="Attack type"
     )
     percentage: float = Field(default=0.0, description="Fraction of nodes compromised")

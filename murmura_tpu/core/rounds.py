@@ -294,8 +294,14 @@ def build_round_program(
         train_key, attack_key = jax.random.split(key)
         honest = 1.0 - compromised
 
-        # 1. local training (compromised nodes frozen — network.py:99-101)
-        params = local_training(params, d, honest, train_key, round_idx)
+        # 1. local training (compromised nodes frozen — network.py:99-101 —
+        # except under data-poisoning attacks, whose compromised nodes
+        # must train on their poisoned shards; Attack.trains_locally)
+        if attack is not None and attack.trains_locally:
+            train_mask = jnp.ones_like(honest)
+        else:
+            train_mask = honest
+        params = local_training(params, d, train_mask, train_key, round_idx)
 
         # 2. snapshot + attack on outgoing states (network.py:105-119)
         own_flat = jax.vmap(ravel)(params)

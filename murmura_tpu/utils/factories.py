@@ -94,6 +94,28 @@ def build_attack(config: Config) -> Optional[Attack]:
             epsilon=p.get("epsilon"),
             seed=seed,
         )
+    if config.attack.type == "label_flip":
+        if config.backend == "distributed":
+            # The ZMQ NodeProcess builds its own data shard; the poison
+            # transform is not wired there, and an identity state attack
+            # over clean data would be a silent no-attack run labeled
+            # "under label_flip" — fail loud instead.
+            raise ConfigError(
+                "attack type 'label_flip' is not wired into the ZMQ "
+                "distributed backend (per-process data is built without "
+                "the poison transform); use backend: simulation/tpu"
+            )
+        ff = float(p.get("flip_fraction", 1.0))
+        if not 0.0 < ff <= 1.0:
+            raise ConfigError(
+                f"attack.params.flip_fraction must be in (0, 1], got {ff}"
+            )
+        return ATTACKS["label_flip"](
+            num_nodes=n,
+            attack_percentage=pct,
+            flip_fraction=ff,
+            seed=seed,
+        )
     if config.attack.type == "topology_liar":
         inner = None
         inner_type = p.get("model_attack_type")
@@ -285,6 +307,19 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         seed=config.topology.seed,
     )
     attack = build_attack(config)
+    if attack is not None and attack.data_poison_fn is not None:
+        if data.x_test is None:
+            # Without a held-out split, evaluation falls back to the
+            # training arrays — compromised nodes would be scored against
+            # their own flipped labels and metric distortion would read
+            # as attack damage.  Fail loud instead of measuring nonsense.
+            raise ConfigError(
+                "data-poisoning attacks need a clean eval split: this "
+                "adapter/config evaluates on the training shard "
+                "(holdout_fraction: 0.0); set holdout_fraction > 0 or "
+                "use an adapter with test shards"
+            )
+        data.y = attack.data_poison_fn(data.y, data.mask, data.num_classes)
     mobility = build_mobility(config)
 
     # Probe sizing: evidential trust uses max_eval_samples

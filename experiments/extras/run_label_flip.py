@@ -43,10 +43,16 @@ BASE = {
 ATTACK = {"enabled": True, "type": "label_flip", "percentage": 0.3,
           "params": {"flip_fraction": 1.0}}
 
+# Distance-based rules (expected to FAIL against data poisoning) and
+# performance-probe rules (expected to DEFEND: the probe evaluates
+# neighbor models on the node's own CLEAN data, and a poisoned model
+# scores badly regardless of how honest its parameters look).
 RULES = {
     "fedavg": {},
     "krum": {"num_compromised": 3},
     "trimmed_mean": {"trim_ratio": 0.3},
+    "ubar": {"rho": 0.7},
+    "evidential_trust": {},
 }
 
 CHANCE = 1.0 / 6.0  # UCI HAR: 6 classes
@@ -86,6 +92,9 @@ def main():
         cfg = dict(BASE)
         cfg["aggregation"] = {"algorithm": rule, "params": dict(params)}
         cfg["attack"] = dict(ATTACK)
+        if rule == "evidential_trust":
+            cfg["model"] = {"factory": "wearables.uci_har",
+                            "params": {"evidential": True}}
         tag = f"{rule}_label_flip"
         results[tag] = run_cfg(cfg, tag)
         print(tag, results[tag], flush=True)
@@ -102,6 +111,18 @@ def main():
         "trimmed_does_not_restore_clean":
             results["trimmed_mean_label_flip"]["final_accuracy"]
             < clean_acc - 0.05,
+        # The other half of the taxonomy: performance-probe rules DO
+        # defend — the probe scores poisoned models on clean local data.
+        "ubar_defends":
+            results["ubar_label_flip"]["final_accuracy"] > clean_acc - 0.05,
+        "evidential_trust_defends":
+            results["evidential_trust_label_flip"]["final_accuracy"]
+            > clean_acc - 0.08,
+        "probes_beat_distance_filters":
+            min(results["ubar_label_flip"]["final_accuracy"],
+                results["evidential_trust_label_flip"]["final_accuracy"])
+            > max(results["krum_label_flip"]["final_accuracy"],
+                  results["trimmed_mean_label_flip"]["final_accuracy"]) + 0.1,
         "all_learn_above_chance": all(
             r["final_accuracy"] > CHANCE + 0.05 for r in results.values()
         ),
@@ -110,8 +131,13 @@ def main():
         "note": (
             "label_flip poisons TRAINING DATA of 30% of nodes "
             "(flip_fraction 1.0); broadcast states are untouched, so "
-            "state-distance filters have nothing to reject — the point "
-            "of the data-poisoning threat model (attacks/label_flip.py)"
+            "state-distance filters have nothing to reject (krum and "
+            "trimmed_mean land BELOW plain fedavg: they filter honest "
+            "heterogeneity while the poison rides through) — while the "
+            "performance-probe rules defend: UBAR's loss probe and "
+            "evidential trust's uncertainty probe score poisoned models "
+            "on clean local data (ubar even beats the clean fedavg "
+            "baseline).  The full defense taxonomy in one scenario."
         ),
         "scenarios": results,
         "checks": checks,

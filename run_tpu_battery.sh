@@ -24,5 +24,6 @@ run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py
 run breakdown256   2400 python bench_breakdown.py --nodes 256
 run sgd_micro      1800 python bench_sgd_micro.py
+run rules256       3600 python bench_rules_256.py
 run scaling        14400 python bench_scaling.py
 echo "battery done $(date)" | tee -a "$OUT/battery.log"

@@ -150,8 +150,16 @@ _CIRCULANT_CHUNK_BYTES = 256 * 1024 * 1024
 
 
 def _p_chunk_len(n: int, p: int, itemsize: int) -> int:
-    """Chunk length along P so one [N, chunk] rolled copy stays in budget."""
-    return max(1, min(p, _CIRCULANT_CHUNK_BYTES // max(1, n * itemsize)))
+    """Chunk length along P so one [N, chunk] rolled copy stays in budget.
+
+    The budget floor is the f32 itemsize even for bf16 inputs: every
+    circulant kernel accumulates its chunk in float32 (distance reduces,
+    weighted sums), so a bf16 program's live per-chunk working set is the
+    f32 upcast, not the resident dtype — sizing by itemsize=2 would double
+    the chunk and hand back the OOM headroom the 256-node north-star run
+    depends on.
+    """
+    return max(1, min(p, _CIRCULANT_CHUNK_BYTES // max(1, n * max(itemsize, 4))))
 
 
 def _p_chunked_accumulate(arrays, chunk_fn, acc_init, p: int, chunk: int):

@@ -71,6 +71,13 @@ def circulant_probe_eval(
         dict of [k, N] arrays, entry [o, i] = metric of the model of node
         (i + offsets[o]) % N evaluated on node i's probe data.
     """
+    if not offsets:
+        raise ValueError(
+            "circulant_probe_eval needs at least one offset: an empty "
+            "offset list means a circulant graph with no neighbors, so "
+            "there is no cross-eval to compute (check the topology's "
+            "circulant_offsets() wiring)"
+        )
 
     def eval_one(flat_j, x_i, y_i, m_i):
         params = ctx.unravel(flat_j)

@@ -263,7 +263,11 @@ def make_geometric_median(
             )
             nb_mask = nb_mask * (1.0 - jnp.eye(n))  # self handled apart
         else:
-            nb_mask = adj.astype(jnp.float32)
+            # Zero the diagonal locally rather than relying on the
+            # generators' zero-diagonal invariant: the self candidate is
+            # added apart (w_self), so a stray self-edge in adj would
+            # double-count own_i in every Weiszfeld step.
+            nb_mask = adj.astype(jnp.float32) * (1.0 - jnp.eye(n))
         cnt = 1.0 + nb_mask.sum(axis=1)  # [N], self always a candidate
 
         def weighted_mean(w_self, w_nb):

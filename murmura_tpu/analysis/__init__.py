@@ -1,0 +1,64 @@
+"""Static analysis + runtime sanitizers for the murmura_tpu codebase.
+
+``python -m murmura_tpu check [path]`` — a JAX-aware lint pass (see
+:mod:`murmura_tpu.analysis.lint`) plus cross-layer contract checks
+(:mod:`murmura_tpu.analysis.contracts`).  The runtime sanitizers
+(:mod:`murmura_tpu.analysis.sanitizers`) are opt-in guards wired into the
+round loop behind ``tpu.recompile_guard`` / ``tpu.transfer_guard``.
+
+Rationale (round-5 verdict): the framework's correctness rests on
+non-local invariants the type system cannot see — zero-diagonal adjacency,
+registry/schema/test sync, no host syncs or recompiles inside the round
+hot path.  ``check`` turns each into a machine-checked contract.  See
+docs/ANALYSIS.md for the rule catalogue and suppression syntax.
+"""
+
+from murmura_tpu.analysis.lint import Finding, lint_file, lint_paths
+from murmura_tpu.analysis.contracts import check_contracts
+from murmura_tpu.analysis.sanitizers import (
+    CompileTracker,
+    RecompileError,
+    track_compiles,
+    transfer_sanitizer,
+)
+
+from pathlib import Path
+from typing import Iterable, List, Optional, Sequence
+
+
+def run_check(
+    paths: Optional[Sequence] = None, contracts: bool = True
+) -> List[Finding]:
+    """Run the full static pass: AST lint over ``paths`` (default: the
+    installed murmura_tpu package) plus the cross-layer contract checks.
+
+    Returns all findings sorted by (path, line); empty means clean.
+    """
+    if not paths:
+        paths = [Path(__file__).resolve().parent.parent]
+    findings = list(lint_paths(paths))
+    if contracts:
+        findings.extend(check_contracts())
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def format_findings(findings: Iterable[Finding]) -> str:
+    """One greppable line per finding: ``path:line: RULE [name] message``."""
+    return "\n".join(
+        f"{f.path}:{f.line}: {f.rule} [{f.name}] {f.message}" for f in findings
+    )
+
+
+__all__ = [
+    "Finding",
+    "lint_file",
+    "lint_paths",
+    "check_contracts",
+    "run_check",
+    "format_findings",
+    "CompileTracker",
+    "RecompileError",
+    "track_compiles",
+    "transfer_sanitizer",
+]

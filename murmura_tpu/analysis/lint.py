@@ -1,0 +1,688 @@
+"""JAX-aware AST lint engine.
+
+Six rule classes over *traced scopes* (functions that execute under a JAX
+trace — ``jit``/``vmap``/``scan`` bodies and the aggregation-rule protocol
+functions) plus two whole-file checks:
+
+====== ================== =====================================================
+rule   name               what it catches
+====== ================== =====================================================
+MUR001 traced-branch      Python ``if``/``while``/``for`` control flow on a
+                          traced value — a ConcretizationTypeError at trace
+                          time at best, a silent recompile-per-value at worst.
+MUR002 traced-assert      ``assert`` on a traced value — either traces away
+                          silently (no check runs on device) or forces a sync.
+MUR003 host-sync          ``.item()``/``.tolist()``/``float()``/``int()``/
+                          ``np.asarray``/``jax.device_get``/``print`` applied
+                          to traced values — a device→host round-trip that
+                          serializes the round hot path.
+MUR004 recompile-hazard   ``jax.jit`` called inside a Python loop (a fresh
+                          cache per iteration) and traced values used as
+                          ``range`` bounds (should be marked static).
+MUR005 import-time-alloc  module-scope ``jnp.*``/``jax.random.*``/
+                          ``jax.devices`` calls — they initialize the XLA
+                          backend at import, before mesh/multihost setup
+                          (parallel/mesh.py) can pin the platform.
+MUR006 dtype-promotion    ``jnp.zeros/ones/full/array/...`` without an
+                          explicit ``dtype`` combined directly with traced
+                          state — the f32 default silently promotes bf16
+                          kernels (tpu.param_dtype) and doubles their HBM
+                          working set.
+====== ================== =====================================================
+
+Traced scopes are found by: ``@jax.jit``-style decorators; functions passed
+by name to ``jit``/``vmap``/``grad``/``lax.scan``-family calls in the same
+module; the aggregation-rule protocol names (``aggregate``,
+``aggregate_circulant`` — AggregatorDef's contract); an explicit
+``# murmura: traced`` marker on the ``def`` line; and anything lexically
+nested inside one of those.
+
+Inside a traced scope a lightweight forward taint pass tracks which names
+hold traced values: parameters seed the set; results of calls involving
+tainted values propagate it; static accessors (``.shape``, ``.dtype``,
+``len()``, ``is None``/``in`` comparisons, the static AggContext fields)
+break it.  This keeps ``if x.shape[0] > 4`` and ``if ctx.evidential`` legal
+while ``if x.sum() > 0`` is flagged.
+
+Suppression: append ``# murmura: ignore[MUR003]`` (comma-separated ids, or
+bare ``ignore`` for all rules) to the flagged line.
+"""
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterator, List, Sequence, Set, Tuple
+
+RULES: Dict[str, str] = {
+    "MUR000": "syntax-error",
+    "MUR001": "traced-branch",
+    "MUR002": "traced-assert",
+    "MUR003": "host-sync",
+    "MUR004": "recompile-hazard",
+    "MUR005": "import-time-alloc",
+    "MUR006": "dtype-promotion",
+    # 1xx = cross-layer contract checks (analysis/contracts.py)
+    "MUR100": "contract-import-failure",
+    "MUR101": "registry-schema-sync",
+    "MUR102": "per-rule-test-coverage",
+    "MUR103": "topology-zero-diagonal",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    @property
+    def name(self) -> str:
+        return RULES.get(self.rule, "unknown")
+
+
+# Attribute reads that yield static (Python-level) values even on tracers,
+# plus the static fields of AggContext (aggregation/base.py) — branching on
+# these is ordinary Python control flow, not traced control flow.
+STATIC_ATTRS = {
+    "shape", "dtype", "ndim", "size", "itemsize", "nbytes",
+    # AggContext static fields
+    "evidential", "num_classes", "total_rounds", "node_axis_sharded",
+}
+
+# Callables whose function-position arguments execute under a trace, mapped
+# to (positional indices, keyword names) where functions actually appear.
+# Only those slots mark a name as traced — data arguments (scan's init/xs,
+# cond's operands) routinely reuse common names like ``init`` that also name
+# unrelated host functions in the same module.
+_FUN0 = ((0,), ("fun", "f", "fn"))
+TRACING_CALLS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "jax.jit": _FUN0, "jit": _FUN0,
+    "jax.vmap": _FUN0, "vmap": _FUN0,
+    "jax.pmap": _FUN0, "pmap": _FUN0,
+    "jax.grad": _FUN0, "grad": _FUN0,
+    "jax.value_and_grad": _FUN0, "value_and_grad": _FUN0,
+    "jax.lax.scan": _FUN0, "lax.scan": _FUN0,
+    "jax.lax.fori_loop": ((2,), ("body_fun",)),
+    "lax.fori_loop": ((2,), ("body_fun",)),
+    "jax.lax.while_loop": ((0, 1), ("cond_fun", "body_fun")),
+    "lax.while_loop": ((0, 1), ("cond_fun", "body_fun")),
+    "jax.lax.map": _FUN0, "lax.map": _FUN0,
+    "jax.lax.cond": ((1, 2), ("true_fun", "false_fun")),
+    "lax.cond": ((1, 2), ("true_fun", "false_fun")),
+    "jax.lax.switch": ((1,), ("branches",)),
+    "lax.switch": ((1,), ("branches",)),
+    "jax.checkpoint": _FUN0, "jax.remat": _FUN0, "jax.eval_shape": _FUN0,
+    "jax.lax.associative_scan": _FUN0, "lax.associative_scan": _FUN0,
+}
+
+# Function names the repo's protocols guarantee are traced: AggregatorDef
+# aggregate functions compile into the jitted round step (core/rounds.py).
+PROTOCOL_TRACED_NAMES = {"aggregate", "aggregate_circulant"}
+
+JIT_NAMES = {"jax.jit", "jit"}
+
+# Array constructors whose dtype defaults to float32 (MUR006).  Maps name to
+# the positional index at which dtype may be passed (None = keyword-only).
+F32_DEFAULT_CTORS = {
+    "jnp.zeros": 1, "jnp.ones": 1, "jnp.empty": 1, "jnp.full": 2,
+    "jnp.array": 1, "jnp.asarray": 1, "jnp.eye": None, "jnp.identity": 1,
+    "jnp.linspace": None,
+}
+
+# array/asarray preserve an array operand's dtype and yield weak types for
+# bare scalars (neither promotes bf16); only list/tuple literals of Python
+# floats commit to the float32 default.
+DTYPE_PRESERVING_CTORS = {"jnp.array", "jnp.asarray"}
+
+HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready", "copy_to_host_async"}
+HOST_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+
+_IGNORE_RE = re.compile(r"#\s*murmura:\s*ignore(?:\[([A-Z0-9,\s]+)\])?")
+_TRACED_MARK_RE = re.compile(r"#\s*murmura:\s*traced\b")
+
+
+def _dotted(node: ast.AST) -> str:
+    """Dotted-name repr of a Name/Attribute chain ('' if not a plain chain)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _has_dtype(call: ast.Call, func: str) -> bool:
+    if any(kw.arg == "dtype" for kw in call.keywords):
+        return True
+    pos = F32_DEFAULT_CTORS.get(func)
+    return pos is not None and len(call.args) > pos
+
+
+class _ModuleScanner:
+    """Whole-file pass: traced-scope discovery, MUR004 (jit-in-loop) and
+    MUR005 (import-time allocation)."""
+
+    def __init__(self, tree: ast.Module, source_lines: List[str], path: str):
+        self.tree = tree
+        self.lines = source_lines
+        self.path = path
+        self.findings: List[Finding] = []
+        self.traced_names: Set[str] = set(PROTOCOL_TRACED_NAMES)
+        self.traced_lambdas: List[ast.Lambda] = []
+
+    def emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        self.findings.append(Finding(rule, self.path, line, message))
+
+    def scan(self) -> List[Finding]:
+        self._collect_traced_names()
+        self._scan_import_time(self.tree.body)
+        self._scan_jit_in_loop(self.tree)
+        for fn in self._traced_roots(self.tree):
+            _TaintScanner(self, fn, inherited=set()).run()
+        for lam in self.traced_lambdas:
+            _TaintScanner(self, _lambda_as_fn(lam), inherited=set()).run()
+        # A lambda passed to jit inside a traced function is scanned both by
+        # the enclosing taint pass and via traced_lambdas — dedupe, keeping
+        # first-seen order.
+        return list(dict.fromkeys(self.findings))
+
+    # -- traced-scope discovery ------------------------------------------
+
+    def _collect_traced_names(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = TRACING_CALLS.get(_dotted(node.func))
+            if spec is None:
+                continue
+            positions, kw_names = spec
+            fn_args = [node.args[i] for i in positions if i < len(node.args)]
+            fn_args += [kw.value for kw in node.keywords if kw.arg in kw_names]
+            for arg in fn_args:
+                # lax.switch takes a list/tuple of branch functions.
+                elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
+                for el in elts:
+                    if isinstance(el, ast.Name):
+                        self.traced_names.add(el.id)
+                    elif isinstance(el, ast.Lambda):
+                        self.traced_lambdas.append(el)
+
+    def _is_traced(self, fn: ast.FunctionDef) -> bool:
+        if fn.name in self.traced_names:
+            return True
+        for dec in fn.decorator_list:
+            d = _dotted(dec)
+            if d in JIT_NAMES:
+                return True
+            if isinstance(dec, ast.Call):
+                dfun = _dotted(dec.func)
+                if dfun in JIT_NAMES:
+                    return True
+                if dfun in {"functools.partial", "partial"} and dec.args:
+                    if _dotted(dec.args[0]) in JIT_NAMES:
+                        return True
+        line = self.lines[fn.lineno - 1] if fn.lineno <= len(self.lines) else ""
+        return bool(_TRACED_MARK_RE.search(line))
+
+    def _traced_roots(self, node) -> Iterator[ast.FunctionDef]:
+        """Outermost traced functions anywhere in the file.  Functions nested
+        inside a traced root are covered by the root's taint scan (closure
+        taint flows down); functions nested in untraced parents are still
+        discovered here (e.g. ``train_round`` inside build_round_program)."""
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._is_traced(child):
+                    yield child
+                else:
+                    yield from self._traced_roots(child)
+            elif not isinstance(child, ast.Lambda):
+                yield from self._traced_roots(child)
+
+    # -- module-level checks ----------------------------------------------
+
+    def _scan_import_time(self, body) -> None:
+        """MUR005: calls executed at module import (module and class scope,
+        pruning function/lambda bodies — those run later)."""
+
+        def walk_pruned(node) -> Iterator[ast.AST]:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    # The body runs later; decorators and defaults (both
+                    # positional and keyword-only) run now.
+                    import_time_exprs = (
+                        child.decorator_list
+                        + child.args.defaults
+                        + [d for d in child.args.kw_defaults if d is not None]
+                    )
+                    for expr in import_time_exprs:
+                        yield expr
+                        yield from walk_pruned(expr)
+                    continue
+                if isinstance(child, ast.Lambda):
+                    continue
+                yield child
+                yield from walk_pruned(child)
+
+        root = ast.Module(body=list(body), type_ignores=[])
+        for sub in walk_pruned(root):
+            if isinstance(sub, ast.Call):
+                f = _dotted(sub.func)
+                if (
+                    f.startswith(("jnp.", "jax.numpy.", "jax.random."))
+                    or f in {
+                        "jax.devices", "jax.local_devices",
+                        "jax.device_count", "jax.local_device_count",
+                        "jax.device_put",
+                    }
+                ):
+                    self.emit(
+                        "MUR005", sub,
+                        f"module-import-time call to {f}() initializes "
+                        "the XLA backend before mesh/platform setup "
+                        "(parallel/mesh.py) — move it inside a function",
+                    )
+
+    def _scan_jit_in_loop(self, fn) -> None:
+        """MUR004(a): a jax.jit call lexically inside a for/while body gets a
+        fresh compile cache per iteration."""
+
+        def walk(node, in_loop: bool) -> None:
+            for child in ast.iter_child_nodes(node):
+                child_in_loop = in_loop or isinstance(child, (ast.For, ast.While))
+                if isinstance(child, ast.Call) and _dotted(child.func) in JIT_NAMES:
+                    if in_loop:
+                        self.emit(
+                            "MUR004", child,
+                            "jax.jit called inside a loop body: each "
+                            "iteration builds a fresh jitted callable with "
+                            "an empty compile cache — hoist the jit out of "
+                            "the loop",
+                        )
+                walk(child, child_in_loop)
+
+        walk(fn, False)
+
+
+class _TaintScanner:
+    """Forward taint pass over one traced function (statements in order).
+
+    ``tainted`` holds names bound to traced values.  Nested function defs
+    recurse with the enclosing taint (closure reads) plus their own params.
+    """
+
+    def __init__(self, module: _ModuleScanner, fn, inherited: Set[str]):
+        self.m = module
+        self.fn = fn
+        self.tainted: Set[str] = set(inherited)
+        a = fn.args
+        for arg in a.posonlyargs + a.args + a.kwonlyargs:
+            self.tainted.add(arg.arg)
+        if a.vararg is not None:
+            self.tainted.add(a.vararg.arg)
+        # **kwargs holds static configuration by convention — not tainted.
+        # Params declared static in the jit decorator are Python values
+        # under the trace — branching on them is legal specialization.
+        self.tainted -= _static_params(fn)
+
+    def run(self) -> None:
+        self._visit_body(self.fn.body)
+
+    # -- statements -------------------------------------------------------
+
+    def _visit_body(self, body) -> None:
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _TaintScanner(self.m, stmt, inherited=set(self.tainted)).run()
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            t = self._expr(value) if value is not None else False
+            targets = (
+                stmt.targets if isinstance(stmt, ast.Assign) else [stmt.target]
+            )
+            for target in targets:
+                if isinstance(stmt, ast.AugAssign):
+                    t = t or self._expr(target)
+                self._bind(target, t)
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            if self._expr(stmt.test):
+                kind = "if" if isinstance(stmt, ast.If) else "while"
+                self.m.emit(
+                    "MUR001", stmt,
+                    f"Python `{kind}` on a traced value inside a traced "
+                    "scope — use jnp.where/lax.cond/lax.while_loop (or mark "
+                    "the operand static)",
+                )
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.For):
+            iter_tainted = self._expr(stmt.iter)
+            if iter_tainted:
+                self.m.emit(
+                    "MUR001", stmt,
+                    "Python `for` iterating a traced value inside a traced "
+                    "scope — use lax.scan/lax.fori_loop",
+                )
+            # Iterating a static container (range, enumerate of offsets...)
+            # yields static values; only a traced iterable taints the target.
+            self._bind(stmt.target, iter_tainted)
+            self._visit_body(stmt.body)
+            self._visit_body(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Assert):
+            if self._expr(stmt.test):
+                self.m.emit(
+                    "MUR002", stmt,
+                    "`assert` on a traced value inside a traced scope — "
+                    "it either traces away (never checked on device) or "
+                    "forces a host sync; use checkify or a masked metric",
+                )
+            return
+        if isinstance(stmt, (ast.Return, ast.Expr)):
+            if stmt.value is not None:
+                self._expr(stmt.value)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                t = self._expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._bind(item.optional_vars, t)
+            self._visit_body(stmt.body)
+            return
+        if isinstance(stmt, ast.Try):
+            self._visit_body(stmt.body)
+            for handler in stmt.handlers:
+                self._visit_body(handler.body)
+            self._visit_body(stmt.orelse)
+            self._visit_body(stmt.finalbody)
+            return
+        # Raise / Pass / Import / Delete / Global ... — walk embedded exprs
+        for sub in ast.iter_child_nodes(stmt):
+            if isinstance(sub, ast.expr):
+                self._expr(sub)
+
+    def _bind(self, target, tainted: bool) -> None:
+        if isinstance(target, ast.Name):
+            if tainted:
+                self.tainted.add(target.id)
+            else:
+                self.tainted.discard(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._bind(el, tainted)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, tainted)
+        # Subscript/Attribute targets mutate containers — leave taint as-is.
+
+    # -- expressions ------------------------------------------------------
+
+    def _expr(self, node) -> bool:
+        """Evaluate taint of an expression, emitting findings on the way."""
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                self._expr(node.value)
+                return False
+            return self._expr(node.value)
+        if isinstance(node, ast.Subscript):
+            self._expr(node.slice)
+            return self._expr(node.value)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.BinOp):
+            lt = self._expr(node.left)
+            rt = self._expr(node.right)
+            self._dtype_promotion(node, lt, rt)
+            return lt or rt
+        if isinstance(node, (ast.UnaryOp,)):
+            return self._expr(node.operand)
+        if isinstance(node, ast.BoolOp):
+            # Materialize before any(): a short-circuiting generator would
+            # skip scanning (and emitting findings in) later operands.
+            return any([self._expr(v) for v in node.values])
+        if isinstance(node, ast.Compare):
+            ts = [self._expr(node.left)] + [self._expr(c) for c in node.comparators]
+            # is/is not/in/not in are host-level identity & containment —
+            # `x is None`, `"loss" in ctx.probe_cross` are static branches.
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn)) for op in node.ops):
+                return False
+            return any(ts)
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test)
+            return self._expr(node.body) or self._expr(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any([self._expr(el) for el in node.elts])
+        if isinstance(node, ast.Dict):
+            return any(
+                [self._expr(v) for v in list(node.keys) + list(node.values) if v]
+            )
+        if isinstance(node, ast.Starred):
+            return self._expr(node.value)
+        if isinstance(node, (ast.JoinedStr, ast.FormattedValue)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.expr):
+                    self._expr(sub)
+            return False
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+            t = False
+            for gen in node.generators:
+                if self._expr(gen.iter):
+                    t = True
+                self._bind(gen.target, t)
+                for cond in gen.ifs:
+                    self._expr(cond)
+            if isinstance(node, ast.DictComp):
+                return self._expr(node.key) or self._expr(node.value) or t
+            return self._expr(node.elt) or t
+        if isinstance(node, ast.Lambda):
+            _TaintScanner(self.m, _lambda_as_fn(node), set(self.tainted)).run()
+            return False
+        if isinstance(node, ast.NamedExpr):
+            t = self._expr(node.value)
+            self._bind(node.target, t)
+            return t
+        # Anything else: conservatively walk children, propagate any taint.
+        return any(
+            [
+                self._expr(sub)
+                for sub in ast.iter_child_nodes(node)
+                if isinstance(sub, ast.expr)
+            ]
+        )
+
+    def _call(self, node: ast.Call) -> bool:
+        func = _dotted(node.func)
+        arg_taints = [self._expr(a) for a in node.args]
+        kw_taints = [self._expr(kw.value) for kw in node.keywords]
+        any_arg_tainted = any(arg_taints) or any(kw_taints)
+
+        # len() of a tracer is its static leading-dim extent — a Python int
+        # under the trace, same as .shape[0] (the docstring's taint-breaker
+        # contract).
+        if func == "len":
+            return False
+
+        # MUR003: host-sync calls on traced values
+        if isinstance(node.func, ast.Attribute) and node.func.attr in HOST_SYNC_METHODS:
+            if self._expr(node.func.value):
+                self.m.emit(
+                    "MUR003", node,
+                    f".{node.func.attr}() on a traced value forces a "
+                    "device->host sync inside the traced scope",
+                )
+                return False
+        if func in HOST_SYNC_BUILTINS and any_arg_tainted:
+            self.m.emit(
+                "MUR003", node,
+                f"{func}() of a traced value forces a device->host sync "
+                "inside the traced scope (use jnp casts instead)",
+            )
+            return False
+        if func.startswith(("np.", "numpy.")) and any_arg_tainted:
+            self.m.emit(
+                "MUR003", node,
+                f"{func}() pulls a traced value to the host inside the "
+                "traced scope — use the jnp equivalent",
+            )
+            return False
+        if func == "jax.device_get":
+            self.m.emit(
+                "MUR003", node,
+                "jax.device_get inside a traced scope is a host sync — "
+                "fetch results outside the compiled program",
+            )
+            return False
+        if func == "print" and any_arg_tainted:
+            self.m.emit(
+                "MUR003", node,
+                "print() of a traced value syncs (or silently prints a "
+                "tracer) inside the traced scope — use jax.debug.print",
+            )
+            return False
+
+        # MUR004(b): traced value as a Python range bound
+        if func == "range" and any_arg_tainted:
+            self.m.emit(
+                "MUR004", node,
+                "traced value used as a range() bound — mark the argument "
+                "static (jit static_argnums) or use lax.fori_loop",
+            )
+            return False
+
+        # Taint of the call result: tainted function object (method on a
+        # traced value) or any tainted argument.  Pure jnp constructions
+        # from static arguments stay untainted (constants under trace).
+        func_obj_tainted = (
+            isinstance(node.func, ast.Attribute) and self._expr(node.func.value)
+        )
+        return func_obj_tainted or any_arg_tainted
+
+    def _dtype_promotion(self, binop: ast.BinOp, lt: bool, rt: bool) -> None:
+        """MUR006: dtype-less f32-default constructor as a direct arithmetic
+        operand of traced state."""
+        for ctor, other_tainted in ((binop.left, rt), (binop.right, lt)):
+            if not other_tainted or not isinstance(ctor, ast.Call):
+                continue
+            func = _dotted(ctor.func)
+            if func not in F32_DEFAULT_CTORS or _has_dtype(ctor, func):
+                continue
+            if func in DTYPE_PRESERVING_CTORS and not (
+                ctor.args and isinstance(ctor.args[0], (ast.Tuple, ast.List))
+            ):
+                continue
+            self.m.emit(
+                "MUR006", ctor,
+                f"{func}() without an explicit dtype defaults to float32 "
+                "and promotes bf16 traced operands (tpu.param_dtype) — "
+                "pass dtype= (e.g. the operand's .dtype)",
+            )
+
+
+def _static_params(fn) -> Set[str]:
+    """Parameter names marked static in a jit decorator on ``fn``.
+
+    Understands ``@jax.jit(..., static_argnums=/static_argnames=...)`` and
+    the ``@functools.partial(jax.jit, static_arg...=...)`` spelling;
+    ``static_argnums`` indices are resolved against the positional
+    parameter order (posonly + args, the order jit itself uses).
+    """
+    if not hasattr(fn, "decorator_list"):
+        return set()
+    positional = [
+        a.arg for a in list(fn.args.posonlyargs) + list(fn.args.args)
+    ]
+    static: Set[str] = set()
+    for dec in fn.decorator_list:
+        if not isinstance(dec, ast.Call):
+            continue
+        dfun = _dotted(dec.func)
+        is_jit_call = dfun in JIT_NAMES
+        is_partial_jit = (
+            dfun in {"functools.partial", "partial"}
+            and dec.args
+            and _dotted(dec.args[0]) in JIT_NAMES
+        )
+        if not (is_jit_call or is_partial_jit):
+            continue
+        for kw in dec.keywords:
+            if kw.arg not in ("static_argnums", "static_argnames"):
+                continue
+            values = (
+                kw.value.elts
+                if isinstance(kw.value, (ast.Tuple, ast.List))
+                else [kw.value]
+            )
+            for v in values:
+                if not isinstance(v, ast.Constant):
+                    continue
+                if isinstance(v.value, str):
+                    static.add(v.value)
+                elif isinstance(v.value, int) and -len(positional) <= v.value < len(positional):
+                    static.add(positional[v.value])
+    return static
+
+
+def _lambda_as_fn(node: ast.Lambda):
+    """Wrap a Lambda so _TaintScanner can treat it like a FunctionDef."""
+    fn = ast.FunctionDef(
+        name="<lambda>", args=node.args,
+        body=[ast.Return(value=node.body, lineno=node.lineno, col_offset=0)],
+        decorator_list=[], returns=None, type_comment=None,
+        lineno=node.lineno, col_offset=node.col_offset,
+    )
+    return fn
+
+
+def _suppressed(findings: List[Finding], lines: List[str]) -> List[Finding]:
+    out = []
+    for f in findings:
+        line = lines[f.line - 1] if 0 < f.line <= len(lines) else ""
+        m = _IGNORE_RE.search(line)
+        if m:
+            ids = m.group(1)
+            if ids is None or f.rule in {s.strip() for s in ids.split(",")}:
+                continue
+        out.append(f)
+    return out
+
+
+def lint_file(path) -> List[Finding]:
+    """Lint one Python file; returns findings after suppression filtering."""
+    p = Path(path)
+    try:
+        source = p.read_text()
+    except (OSError, UnicodeDecodeError) as e:
+        return [Finding("MUR000", str(p), 1, f"unreadable file: {e}")]
+    try:
+        tree = ast.parse(source, filename=str(p))
+    except SyntaxError as e:
+        return [Finding("MUR000", str(p), e.lineno or 1, f"syntax error: {e.msg}")]
+    lines = source.splitlines()
+    findings = _ModuleScanner(tree, lines, str(p)).scan()
+    return _suppressed(findings, lines)
+
+
+def lint_paths(paths: Sequence) -> List[Finding]:
+    """Lint every ``*.py`` under each path (files or directories)."""
+    findings: List[Finding] = []
+    for path in paths:
+        p = Path(path)
+        files = sorted(p.rglob("*.py")) if p.is_dir() else [p]
+        for f in files:
+            findings.extend(lint_file(f))
+    return findings

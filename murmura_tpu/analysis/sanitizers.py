@@ -1,0 +1,176 @@
+"""Opt-in runtime sanitizers for the round hot path.
+
+Two guards, each behind a config flag (``tpu.recompile_guard`` /
+``tpu.transfer_guard``) and wired around the Network round loop
+(core/network.py):
+
+- **Recompile sanitizer** (:func:`track_compiles`): counts XLA backend
+  compiles via the jax.monitoring ``/jax/core/compile`` duration events —
+  zero-overhead when quiet, fires exactly once per real compile and never
+  on cache hits.  The orchestrator brackets each round with
+  ``tracker.begin``/``tracker.end``; a compile in a round after the
+  program's warmup execution raises :class:`RecompileError` instead of
+  silently degrading a 60ms round into a multi-second XLA build (the
+  dominant silent-regression class for the rounds/sec headline).
+
+- **Transfer sanitizer** (:func:`transfer_sanitizer`):
+  ``jax.transfer_guard("disallow")`` around the round loop.  The loop's
+  deliberate transfers are all *explicit* (``jnp.asarray`` of the per-round
+  adjacency, ``jax.device_get`` of recorded metrics) and pass the guard;
+  what it catches is *implicit* traffic — a numpy array slipped directly
+  into the jitted step, a tracer forced to host mid-trace — each a
+  serializing device sync the profiler only shows after the fact.
+"""
+
+import contextlib
+import threading
+from typing import Iterator, List, Optional, Tuple
+
+import jax
+
+# One backend_compile event fires per XLA compilation; trace/lowering
+# events also exist but re-tracing without re-compiling is cheap enough
+# not to guard.
+_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_listener_installed = False
+_compile_count = 0
+
+
+def _on_event_duration(event: str, duration: float, **_kwargs) -> None:
+    global _compile_count
+    if event == _COMPILE_EVENT:
+        with _lock:
+            _compile_count += 1
+
+
+def _install_listener() -> None:
+    # jax.monitoring has no unregister API, so the listener installs once
+    # per process and trackers snapshot the monotonic counter instead.
+    global _listener_installed
+    with _lock:
+        if not _listener_installed:
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_event_duration
+            )
+            _listener_installed = True
+
+
+def compile_count() -> int:
+    """Process-wide XLA compilations observed since the listener installed."""
+    return _compile_count
+
+
+class RecompileError(RuntimeError):
+    """An XLA compilation happened in a round after warmup.
+
+    Post-warmup compiles mean the round program's signature is unstable —
+    a shape/dtype drifting between rounds, a non-hashable static arg, a
+    fresh ``jax.jit`` per iteration — and each one stalls the device for
+    the full XLA build.  See docs/ANALYSIS.md (recompile sanitizer).
+    """
+
+
+class CompileTracker:
+    """Per-run compile counter with round bracketing.
+
+    ``begin(label)`` snapshots the counter; ``end(allow=...)`` records the
+    round's compile delta and raises :class:`RecompileError` when a
+    non-warmup round compiled.  ``per_round`` keeps (label, compiles) pairs
+    for diagnostics.
+
+    The underlying counter is process-wide (jax.monitoring has no
+    per-callsite events), so *any* compile that lands inside a bracket
+    counts — including a first-time eager op in user callback code or a
+    second guarded Network in the same process.  That is deliberate for a
+    sanitizer (every compile inside the round window stalls the device,
+    whoever triggered it), but it means the blamed round program is not
+    necessarily the unstable one; the error message says so.
+    """
+
+    def __init__(self) -> None:
+        _install_listener()
+        self._baseline = compile_count()
+        self._round_start: Optional[int] = None
+        self._sub_start = 0
+        self._label = ""
+        self.per_round: List[Tuple[str, int]] = []
+
+    @property
+    def total(self) -> int:
+        """Compiles since this tracker was created."""
+        return compile_count() - self._baseline
+
+    def begin(self, label: str) -> None:
+        self._round_start = compile_count()
+        self._sub_start = self._round_start
+        self._label = label
+
+    def mark(self, allow: bool = False) -> int:
+        """Close a sub-phase inside the current bracket; returns its count.
+
+        Lets a bracket spanning two programs with different warmup states
+        (the per-round step + eval pair) check each phase independently —
+        otherwise one program's warmup round would whitelist a post-warmup
+        recompile of the other.  The per_round report still gets one entry
+        for the whole bracket at ``end``.
+        """
+        if self._round_start is None:
+            raise RuntimeError("CompileTracker.mark() without begin()")
+        delta = compile_count() - self._sub_start
+        self._sub_start = compile_count()
+        if delta and not allow:
+            # Record the full-bracket delta, same unit end() reports, so
+            # last_compile_report stays comparable across rounds.
+            self.per_round.append(
+                (self._label, compile_count() - self._round_start)
+            )
+            self._round_start = None
+            raise RecompileError(self._violation(delta))
+        return delta
+
+    def end(self, allow: bool = False) -> int:
+        """Close the current bracket; returns its total compile count.
+
+        Args:
+            allow: True for warmup phases (a program's first execution
+                legitimately compiles); False raises on any compile since
+                the last ``mark`` (or ``begin``).
+        """
+        if self._round_start is None:
+            raise RuntimeError("CompileTracker.end() without begin()")
+        delta = compile_count() - self._round_start
+        sub_delta = compile_count() - self._sub_start
+        self.per_round.append((self._label, delta))
+        self._round_start = None
+        if sub_delta and not allow:
+            raise RecompileError(self._violation(sub_delta))
+        return delta
+
+    def _violation(self, delta: int) -> str:
+        return (
+            f"{delta} XLA compilation(s) during {self._label!r} after "
+            "warmup — a program signature is unstable (shape/dtype "
+            "drift or non-static argument), or other code compiled "
+            "inside the round window (the counter is process-wide); "
+            f"history: {self.per_round}"
+        )
+
+
+@contextlib.contextmanager
+def track_compiles() -> Iterator[CompileTracker]:
+    """Context manager yielding a fresh :class:`CompileTracker`."""
+    yield CompileTracker()
+
+
+@contextlib.contextmanager
+def transfer_sanitizer() -> Iterator[None]:
+    """``jax.transfer_guard("disallow")`` scope for the round loop.
+
+    Explicit transfers (``jnp.asarray``, ``jax.device_put``,
+    ``jax.device_get``) pass; implicit host↔device traffic raises inside
+    the scope.
+    """
+    with jax.transfer_guard("disallow"):
+        yield

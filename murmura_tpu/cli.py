@@ -166,6 +166,38 @@ def run_node(config_path: Path, node_id, t_start, run_id, host):
         _die_config_error(e)
 
 
+@app.command()
+@click.argument(
+    "paths", nargs=-1, type=click.Path(exists=True, path_type=Path)
+)
+@click.option(
+    "--contracts/--no-contracts", default=True,
+    help="Also run the cross-layer contract checks (registry/schema/test "
+         "sync, topology zero-diagonal)",
+)
+def check(paths, contracts):
+    """JAX-aware static analysis over PATHS (default: the installed
+    murmura_tpu package).
+
+    Runs the AST lint rules (MUR001-006: traced branches, host syncs,
+    recompilation hazards, import-time allocation, dtype promotion) plus
+    the cross-layer contract checks (MUR101-103).  Exits non-zero when any
+    finding survives suppression.  See docs/ANALYSIS.md for the rule
+    catalogue and the ``# murmura: ignore[...]`` suppression syntax.
+    """
+    from murmura_tpu.analysis import format_findings, run_check
+
+    findings = run_check(list(paths) or None, contracts=contracts)
+    if findings:
+        click.echo(format_findings(findings))
+        console.print(
+            f"[bold red]{len(findings)} finding(s)[/bold red] "
+            "(see docs/ANALYSIS.md for rules and suppression)"
+        )
+        raise SystemExit(1)
+    console.print("[bold green]murmura check: clean[/bold green]")
+
+
 @app.command("list-components")
 @click.argument("component_type", required=False, default=None)
 def list_components(component_type):

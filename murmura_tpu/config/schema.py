@@ -246,6 +246,25 @@ class TPUConfig(_Strict):
     profile_dir: Optional[str] = Field(
         default=None, description="If set, write a jax.profiler trace here"
     )
+    recompile_guard: bool = Field(
+        default=False,
+        description=(
+            "Runtime sanitizer: count XLA compilations per round and fail "
+            "the run (analysis.sanitizers.RecompileError) if any occur "
+            "after a program's warmup execution — post-warmup compiles "
+            "mean the round signature is unstable and each one stalls the "
+            "device for a full XLA build. Works on every backend."
+        ),
+    )
+    transfer_guard: bool = Field(
+        default=False,
+        description=(
+            "Runtime sanitizer: run the round loop under "
+            "jax.transfer_guard('disallow') so implicit host<->device "
+            "transfers raise instead of silently serializing the hot "
+            "path (explicit jnp.asarray/device_get traffic still passes)."
+        ),
+    )
 
 
 class Config(_Strict):

@@ -7,6 +7,7 @@ statistics (network.py:201-210).  The same orchestrator serves both the
 sharded over a mesh) — only the compilation of the step differs.
 """
 
+import contextlib
 import time
 from typing import Any, Dict, List, Optional
 
@@ -34,6 +35,8 @@ class Network:
         seed: int = 42,
         donate: bool = True,
         profile_dir: Optional[str] = None,
+        recompile_guard: bool = False,
+        transfer_guard: bool = False,
     ):
         self.program = program
         self.topology = topology
@@ -42,6 +45,19 @@ class Network:
         self.backend = backend
         self.seed = seed
         self.profile_dir = profile_dir
+        # Opt-in runtime sanitizers (tpu.recompile_guard / tpu.transfer_guard;
+        # analysis/sanitizers.py).  Backend-independent: the simulation
+        # backend exercises them in CI where no chip is at stake.
+        self.recompile_guard = recompile_guard
+        self.transfer_guard = transfer_guard
+        self._tracker = None
+        # (label, compiles) per round bracket from the last guarded train()
+        # — diagnostics for tests and post-mortems.
+        self.last_compile_report: Optional[List] = None
+        # Programs that have already executed once (and thus compiled):
+        # "step", "eval", ("fused", chunk, eval_every).  A compile in any
+        # later round is a post-warmup recompile and fails the guard.
+        self._warmed: set = set()
 
         n = program.num_nodes
         if topology.num_nodes != n:
@@ -56,7 +72,12 @@ class Network:
         )
 
         if backend == "tpu":
-            from murmura_tpu.parallel.mesh import shard_eval_step, shard_step
+            from murmura_tpu.parallel.mesh import (
+                adj_stack_sharding,
+                make_shardings,
+                shard_eval_step,
+                shard_step,
+            )
 
             if mesh is None:
                 from murmura_tpu.parallel.mesh import make_mesh
@@ -65,21 +86,42 @@ class Network:
             self.mesh = mesh
             self._step = shard_step(program.train_step, program, mesh, donate=donate)
             self._eval = shard_eval_step(program.eval_step, program, mesh)
+            self._node_s, self._repl = make_shardings(mesh)
+            self._adj_stack_s = adj_stack_sharding(mesh)
         else:
             self.mesh = None
             donate_argnums = (0, 1) if donate else ()
             self._step = jax.jit(program.train_step, donate_argnums=donate_argnums)
             self._eval = jax.jit(program.eval_step)
+            self._node_s = self._repl = self._adj_stack_s = None
+        if transfer_guard and jax.process_count() > 1:
+            raise ValueError(
+                "tpu.transfer_guard is single-host only: multi-host "
+                "resident state cannot be explicitly pre-placed with "
+                "jax.device_put, so the guard would flag the legitimate "
+                "cross-process staging"
+            )
 
         # Mutable run state
         self.params = program.init_params
         self.agg_state = {k: jnp.asarray(v) for k, v in program.init_agg_state.items()}
         self._data = {k: jnp.asarray(v) for k, v in program.data_arrays.items()}
+        self._place_resident_state()
         # Base key; round r always runs with fold_in(base, r), so the stream
         # is a pure function of (seed, round) — identical across per-round
         # and fused dispatch, any rounds_per_dispatch chunking, and
         # checkpoint resume points.
         self._rng = jax.random.PRNGKey(seed)
+        # Jitted so its internal constants compile into the program instead
+        # of landing as per-round implicit host->device transfers (eager
+        # fold_in stages them eagerly and trips tpu.transfer_guard).
+        self._fold_in = jax.jit(jax.random.fold_in)
+        # Deferred-quiesce scalar fetch (see _train_rounds): built once here
+        # so repeated defer_metrics train() calls reuse one compile cache
+        # instead of paying a fresh XLA compile per call.
+        self._first_scalar = jax.jit(
+            lambda tree: jax.tree_util.tree_leaves(tree)[0].ravel()[0]
+        )
 
         # History schema parity (reference: network.py:47-58)
         self.history: Dict[str, List[Any]] = {
@@ -101,6 +143,41 @@ class Network:
         # evidential-loss annealing) and the mobility G^t keep advancing
         # across successive train() calls and checkpoint resumes.
         self.current_round = 0
+
+    def _place_resident_state(self) -> None:
+        """Explicitly place params/agg_state/data on the mesh (tpu backend,
+        single host).
+
+        Without this the first sharded jit call reshards every single-device
+        input implicitly — a device-to-device transfer per buffer that (a)
+        trips tpu.transfer_guard and (b) repeats after every checkpoint
+        restore.  Multi-host placement stays with the jit staging path
+        (device_put cannot target non-addressable devices).
+        """
+        if self._node_s is None or jax.process_count() > 1:
+            return
+        from murmura_tpu.parallel.mesh import _shard_leading_axis
+
+        place = lambda tree: jax.device_put(  # noqa: E731
+            tree, _shard_leading_axis(tree, self._node_s, self._repl)
+        )
+        self.params = place(self.params)
+        self.agg_state = place(self.agg_state)
+        self._data = place(self._data)
+
+    def _stage(self, value, sharding):
+        """Stage one loop input explicitly: plain device transfer off-mesh,
+        ``jax.device_put`` to the target sharding on the tpu backend (jit
+        would otherwise reshard implicitly — see _place_resident_state).
+
+        Multi-host keeps the jit ``in_shardings`` staging path: device_put
+        to a non-addressable sharding is a blocking cross-process broadcast
+        collective per call (and unsupported on some backends), which would
+        cost more per round than the implicit reshard it avoids.
+        """
+        if sharding is None or jax.process_count() > 1:
+            return jnp.asarray(value)
+        return jax.device_put(value, sharding)
 
     def _adjacency_for_round(self, round_idx: int) -> np.ndarray:
         if self.mobility is not None:
@@ -172,28 +249,55 @@ class Network:
         if profile:
             jax.profiler.start_trace(self.profile_dir)
         try:
-            if rounds_per_dispatch > 1:
-                if defer_metrics:
-                    import warnings
+            with self._sanitizer_scope():
+                if rounds_per_dispatch > 1:
+                    if defer_metrics:
+                        import warnings
 
-                    warnings.warn(
-                        "defer_metrics is ignored when rounds_per_dispatch > 1: "
-                        "the fused scan already syncs metrics once per chunk",
-                        stacklevel=2,
+                        warnings.warn(
+                            "defer_metrics is ignored when rounds_per_dispatch > 1: "
+                            "the fused scan already syncs metrics once per chunk",
+                            stacklevel=2,
+                        )
+                    self._train_fused(
+                        rounds, verbose, eval_every, checkpoint_dir,
+                        checkpoint_every, rounds_per_dispatch,
                     )
-                self._train_fused(
-                    rounds, verbose, eval_every, checkpoint_dir,
-                    checkpoint_every, rounds_per_dispatch,
-                )
-            else:
-                self._train_rounds(
-                    rounds, verbose, eval_every, checkpoint_dir,
-                    checkpoint_every, defer_metrics,
-                )
+                else:
+                    self._train_rounds(
+                        rounds, verbose, eval_every, checkpoint_dir,
+                        checkpoint_every, defer_metrics,
+                    )
         finally:
             if profile:
                 jax.profiler.stop_trace()
         return self.history
+
+    @contextlib.contextmanager
+    def _sanitizer_scope(self):
+        """Arm the opt-in runtime sanitizers around one train() call.
+
+        ``tpu.transfer_guard``: jax.transfer_guard("disallow") over the
+        round loop — the loop's deliberate transfers are explicit
+        (jnp.asarray / device_get) and pass; implicit traffic raises.
+        ``tpu.recompile_guard``: a CompileTracker the round loops bracket
+        each round with; post-warmup compiles raise RecompileError.
+        """
+        with contextlib.ExitStack() as stack:
+            if self.transfer_guard:
+                from murmura_tpu.analysis.sanitizers import transfer_sanitizer
+
+                stack.enter_context(transfer_sanitizer())
+            if self.recompile_guard:
+                from murmura_tpu.analysis.sanitizers import track_compiles
+
+                self._tracker = stack.enter_context(track_compiles())
+            try:
+                yield
+            finally:
+                if self._tracker is not None:
+                    self.last_compile_report = list(self._tracker.per_round)
+                self._tracker = None
 
     def _fused_step(self, chunk: int, eval_every: int):
         """Compiled fused multi-round program, cached per (chunk, cadence)."""
@@ -219,28 +323,34 @@ class Network:
         self, rounds, verbose, eval_every, checkpoint_dir, checkpoint_every,
         chunk,
     ) -> None:
-        comp = jnp.asarray(self.compromised)
+        comp = self._stage(self.compromised, self._node_s)
         done = 0
         while done < rounds:
             k = min(chunk, rounds - done)
             step = self._fused_step(k, eval_every)
             round0 = self.current_round
             t0 = time.perf_counter()
-            adj_stack = jnp.asarray(
+            program_key = ("fused", k, eval_every)
+            if self._tracker is not None:
+                self._tracker.begin(f"rounds {round0}..{round0 + k - 1}")
+            adj_stack = self._stage(
                 np.stack(
                     [self._adjacency_for_round(round0 + i) for i in range(k)]
-                )
+                ),
+                self._adj_stack_s,
             )
             self.params, self.agg_state, rows = step(
                 self.params,
                 self.agg_state,
-                self._rng,
+                self._stage(self._rng, self._repl),
                 adj_stack,
                 comp,
-                jnp.asarray(round0, dtype=jnp.int32),
+                self._stage(np.asarray(round0, np.int32), self._repl),
                 self._data,
             )
             rows = jax.device_get(rows)
+            chunk_warmup = program_key not in self._warmed
+            self._warmed.add(program_key)
             self.current_round = round0 + k
             # Keep round_times in per-round units across dispatch modes:
             # one amortized entry per round, not one per chunk (the chunk
@@ -260,6 +370,12 @@ class Network:
                         },
                         verbose,
                     )
+            # After the bookkeeping: a guard raise must leave
+            # current_round/history aligned with the already-advanced
+            # (donated) params, or a catch-and-checkpoint caller would
+            # record k-rounds-stale metadata beside the new state.
+            if self._tracker is not None:
+                self._tracker.end(allow=chunk_warmup)
             crossed_cadence = checkpoint_every and (
                 self.current_round // checkpoint_every > round0 // checkpoint_every
             )
@@ -270,31 +386,52 @@ class Network:
         self, rounds, verbose, eval_every, checkpoint_dir, checkpoint_every,
         defer_metrics=False,
     ) -> None:
-        comp = jnp.asarray(self.compromised)
+        comp = self._stage(self.compromised, self._node_s)
         last_saved = -1
         pending: List[Any] = []
         for _ in range(rounds):
             round_idx = self.current_round
             t0 = time.perf_counter()
-            adj = jnp.asarray(self._adjacency_for_round(round_idx))
-            step_key = jax.random.fold_in(self._rng, round_idx)
+            warmup = "step" not in self._warmed
+            if self._tracker is not None:
+                self._tracker.begin(f"round {round_idx}")
+            adj = self._stage(self._adjacency_for_round(round_idx), self._node_s)
+            # 0-d numpy staging: scalar conversions from numpy ARRAYS are
+            # explicit transfers (transfer_guard-clean); Python/numpy
+            # scalars would be implicit and trip the sanitizer.
+            step_key = self._stage(
+                self._fold_in(
+                    self._rng, jnp.asarray(np.asarray(round_idx, np.uint32))
+                ),
+                self._repl,
+            )
             self.params, self.agg_state, agg_metrics = self._step(
                 self.params,
                 self.agg_state,
                 step_key,
                 adj,
                 comp,
-                jnp.asarray(round_idx, dtype=jnp.float32),
+                self._stage(np.asarray(round_idx, np.float32), self._repl),
                 self._data,
             )
+            self._warmed.add("step")
             self.current_round = round_idx + 1
             if self.current_round % eval_every == 0:
+                # Close the step phase before eval runs: eval's own warmup
+                # must not whitelist a post-warmup step recompile landing
+                # in the same round (and vice versa).
+                if self._tracker is not None:
+                    self._tracker.mark(allow=warmup)
+                warmup = "eval" not in self._warmed
                 metrics = {**self._eval(self.params, self._data), **agg_metrics}
+                self._warmed.add("eval")
                 if defer_metrics:
                     pending.append((self.current_round, metrics))
                 else:
                     metrics = jax.device_get(metrics)
                     self._record(self.current_round, metrics, verbose)
+            if self._tracker is not None:
+                self._tracker.end(allow=warmup)
             self.round_times.append(time.perf_counter() - t0)
             if (
                 checkpoint_dir
@@ -314,7 +451,9 @@ class Network:
             # only after every dispatched round has executed, so wall-clock
             # timing around a deferred train() call is honest.
             if jax.process_count() == 1:
-                jax.device_get(jax.tree_util.tree_leaves(self.params)[0].ravel()[0])
+                # Jitted: eager [0]-indexing stages its slice start as an
+                # implicit scalar transfer and trips tpu.transfer_guard.
+                jax.device_get(self._first_scalar(self.params))
             else:
                 # Multi-host: params are sharded across non-addressable
                 # devices, so a scalar fetch would raise; block on the
@@ -354,6 +493,7 @@ class Network:
         )
         self.params = jax.tree_util.tree_map(jnp.asarray, params)
         self.agg_state = {k: jnp.asarray(v) for k, v in agg_state.items()}
+        self._place_resident_state()
         self._rng = jnp.asarray(rng)
         self.current_round = round_num
         self.history = history

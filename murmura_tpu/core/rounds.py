@@ -169,7 +169,7 @@ def build_round_program(
     }
 
     # ---- per-node loss ----------------------------------------------------
-    def node_loss(params_i, xb, yb, mb, key, round_idx):
+    def node_loss(params_i, xb, yb, mb, key, round_idx):  # murmura: traced
         outputs = model.apply(params_i, xb, key, True)
         if evidential:
             lambda_t = (
@@ -181,7 +181,7 @@ def build_round_program(
 
     grad_fn = jax.grad(node_loss)
 
-    def local_training(params, d, honest, key, round_idx):
+    def local_training(params, d, honest, key, round_idx):  # murmura: traced
         """local_epochs x masked-batch SGD (reference: node.py:59-109)."""
 
         def epoch_body(params, epoch_key):
@@ -224,7 +224,7 @@ def build_round_program(
         return params
 
     # ---- evaluation (node.py:111-196) ------------------------------------
-    def evaluate(params, x, y, mask):
+    def evaluate(params, x, y, mask):  # murmura: traced
         s = x.shape[1]
         chunk = min(eval_chunk, s)
         n_chunks = -(-s // chunk)
@@ -290,7 +290,7 @@ def build_round_program(
     attack_apply = attack.apply if attack is not None else None
     claims_fn = attack.claims_fn if attack is not None else None
 
-    def train_round(params, agg_state, key, adj, compromised, round_idx, d):
+    def train_round(params, agg_state, key, adj, compromised, round_idx, d):  # murmura: traced
         train_key, attack_key = jax.random.split(key)
         honest = 1.0 - compromised
 
@@ -363,7 +363,7 @@ def build_round_program(
         metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
         return params, agg_state, metrics
 
-    def eval_step(params, d):
+    def eval_step(params, d):  # murmura: traced
         # evaluation (network.py:141-199) — held-out arrays when the data
         # loader provided them (eval_arrays), else the training shard.
         return evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
@@ -415,7 +415,7 @@ def build_multi_round(program: RoundProgram, chunk: int, eval_every: int):
         {k: as_struct(v) for k, v in program.data_arrays.items()},
     )
 
-    def multi_round(params, agg_state, base_key, adj_stack, compromised, round0, data):
+    def multi_round(params, agg_state, base_key, adj_stack, compromised, round0, data):  # murmura: traced
         def body(carry, xs):
             params, agg_state = carry
             i, adj = xs

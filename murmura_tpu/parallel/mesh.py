@@ -23,6 +23,28 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def backend_initialized() -> bool:
+    """Whether an XLA backend already exists in this process — checked
+    WITHOUT creating one (``jax.devices()`` would).
+
+    This is the runtime twin of the MUR005 lint rule (analysis/lint.py):
+    module-import-time ``jnp.*`` work initializes the backend before
+    :func:`init_multihost` can pin the platform/topology, and the resulting
+    jax.distributed failure modes are far less legible than failing here.
+    """
+    from jax._src import xla_bridge
+
+    # backends_are_initialized() is the helper jax.distributed itself uses;
+    # the _backends dict is the fallback for versions without it.  Both are
+    # private (jax._src has no stability guarantee), so a future rename
+    # fails OPEN — the guard stops firing rather than breaking every
+    # init_multihost call; MUR005 remains the static line of defense.
+    probe = getattr(xla_bridge, "backends_are_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    return bool(getattr(xla_bridge, "_backends", None))
+
+
 def init_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,
@@ -39,6 +61,15 @@ def init_multihost(
     """
     if getattr(jax.distributed, "is_initialized", lambda: False)():
         return
+    if backend_initialized():
+        raise RuntimeError(
+            "init_multihost called after an XLA backend was already "
+            "initialized in this process: jax.distributed cannot join a "
+            "run once single-process devices exist.  Something executed a "
+            "jax computation (often a module-import-time jnp.* call — the "
+            "MUR005 lint class, `python -m murmura_tpu check`) before the "
+            "mesh setup; move it inside a function"
+        )
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -131,15 +162,20 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
     return _shard_round_fn(step, program, mesh, node_s, donate)
 
 
+def adj_stack_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of the fused-dispatch adjacency stack [chunk, N, N]: sharded
+    on its *second* axis (each device holds its nodes' rows for every round
+    of the chunk).  Shared by :func:`shard_multi_round` and the
+    orchestrator's explicit input staging (Network._stage)."""
+    return NamedSharding(mesh, P(None, "nodes"))
+
+
 def shard_multi_round(multi_round, program, mesh: Mesh, donate: bool = True):
     """Jit a fused multi-round scan (core.rounds.build_multi_round) over
-    ``mesh`` with the same node-axis layout as :func:`shard_step`.
-
-    The per-round adjacency stack [chunk, N, N] is sharded on its *second*
-    axis (each device holds its nodes' rows for every round of the chunk).
-    """
-    adj_stack_s = NamedSharding(mesh, P(None, "nodes"))
-    return _shard_round_fn(multi_round, program, mesh, adj_stack_s, donate)
+    ``mesh`` with the same node-axis layout as :func:`shard_step`."""
+    return _shard_round_fn(
+        multi_round, program, mesh, adj_stack_sharding(mesh), donate
+    )
 
 
 def shard_eval_step(eval_step, program, mesh: Mesh):

@@ -414,4 +414,6 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         seed=seed,
         donate=config.tpu.donate_state,
         profile_dir=config.tpu.profile_dir,
+        recompile_guard=config.tpu.recompile_guard,
+        transfer_guard=config.tpu.transfer_guard,
     )

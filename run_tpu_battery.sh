@@ -20,6 +20,18 @@ run() {
   echo "$name rc=$rc" | tee -a "$OUT/battery.log"
   tail -1 "$OUT/$name.out" >> "$OUT/battery.log"
 }
+# Pre-flight gate: the static analyzer (docs/ANALYSIS.md) must be clean
+# before any bench touches the chip — a traced-branch/host-sync/recompile
+# hazard in the round path invalidates every number the battery produces.
+# CPU-pinned so the gate itself cannot wedge the single-tenant TPU.
+echo "=== preflight: murmura check ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+if ! timeout 300 env JAX_PLATFORMS=cpu python -m murmura_tpu check murmura_tpu/ \
+    > "$OUT/preflight_check.out" 2>&1; then
+  echo "preflight murmura check FAILED — aborting battery" | tee -a "$OUT/battery.log"
+  cat "$OUT/preflight_check.out" | tee -a "$OUT/battery.log"
+  exit 1
+fi
+echo "preflight check clean" | tee -a "$OUT/battery.log"
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py
 run breakdown256   2400 python bench_breakdown.py --nodes 256

@@ -661,6 +661,53 @@ class TestGeometricMedian:
         share = np.asarray(stats["max_weight_share"])
         assert (share[:3] > 0.3).all(), share  # honest nodes: ~1/3 each over 3 near-identical
 
+    def test_bf16_matches_f32_within_tolerance(self):
+        """tpu.param_dtype auto-default: >= 64 nodes store bf16 resident
+        states, but the Weiszfeld iterate (robust_stats.py dense Gram path)
+        accumulates distances and weighted means in f32 regardless of input
+        dtype.  The bf16 result must therefore land within bf16
+        quantization of the f32 result: rtol 1/128 (8-bit mantissa -> one
+        part in 2^8, taken x2 for the final-store rounding of inputs AND
+        output) plus a matching atol for near-zero coordinates.  Future
+        nu/iters changes that break f32 accumulation show up here as a
+        gross (not 1-ulp) divergence."""
+        rng = np.random.default_rng(11)
+        n, p = 8, 96
+        own = rng.normal(size=(n, p)).astype(np.float32)
+        bcast = own + 0.1 * rng.normal(size=(n, p)).astype(np.float32)
+        bcast[2] += 50.0  # one outlier so the reweighting actually ranks
+        adj = _full_adj(n)
+        agg = build_aggregator("geometric_median", {"max_iters": 16})
+        z32, _, _ = agg.aggregate(
+            jnp.asarray(own), jnp.asarray(bcast), adj,
+            jnp.asarray(0.0), {}, _ctx(),
+        )
+        z16, _, _ = agg.aggregate(
+            jnp.asarray(own, jnp.bfloat16), jnp.asarray(bcast, jnp.bfloat16),
+            adj, jnp.asarray(0.0), {}, _ctx(),
+        )
+        assert z16.dtype == jnp.bfloat16  # stored in the resident dtype
+        np.testing.assert_allclose(
+            np.asarray(z16, dtype=np.float32), np.asarray(z32),
+            rtol=2 / 128, atol=2 / 128,
+        )
+
+    def test_self_edges_in_adjacency_are_ignored(self):
+        """The uncapped Gram path zeroes the adjacency diagonal locally
+        (ISSUE-1 satellite): a stray self-edge must not double-count the
+        node's own state, so diag-1 and diag-0 adjacencies agree."""
+        rng = np.random.default_rng(12)
+        own = rng.normal(size=(5, 7)).astype(np.float32)
+        bcast = own + rng.normal(size=(5, 7)).astype(np.float32)
+        adj_clean = _full_adj(5)
+        adj_selfy = jnp.asarray(np.asarray(adj_clean) + np.eye(5, dtype=np.float32))
+        agg = build_aggregator("geometric_median", {"max_iters": 16})
+        z_clean, _, _ = _run(agg, own, adj_clean, bcast=bcast)
+        z_selfy, _, _ = _run(agg, own, adj_selfy, bcast=bcast)
+        np.testing.assert_allclose(
+            np.asarray(z_selfy), np.asarray(z_clean), atol=1e-5
+        )
+
     def test_config_wiring_learns_under_attack(self):
         # Full config -> factories -> network path: schema accepts the
         # algorithm, factories inject max_candidates on static graphs, and
@@ -719,3 +766,32 @@ class TestGeometricMedian:
             np.asarray(stats_d["max_weight_share"]),
             np.asarray(stats_c["max_weight_share"]), atol=1e-5,
         )
+
+
+class TestSatelliteGuards:
+    """ISSUE-1 satellite regressions: explicit probe-offset guard and the
+    f32-floored circulant chunk budget."""
+
+    def test_circulant_probe_eval_rejects_empty_offsets(self):
+        from murmura_tpu.aggregation.probe import circulant_probe_eval
+
+        with pytest.raises(ValueError, match="at least one offset"):
+            circulant_probe_eval(
+                jnp.zeros((4, 8)), [], _ctx(), lambda o, y, m: {"loss": 0.0}
+            )
+
+    def test_p_chunk_len_budgets_f32_for_bf16(self):
+        """bf16 programs accumulate chunks in f32, so the chunk budget must
+        use the f32 itemsize — bf16 and f32 inputs get the same chunk."""
+        from murmura_tpu.aggregation.base import (
+            _CIRCULANT_CHUNK_BYTES,
+            _p_chunk_len,
+        )
+
+        n, p = 256, 10_000_000
+        assert _p_chunk_len(n, p, 2) == _p_chunk_len(n, p, 4)
+        assert _p_chunk_len(n, p, 4) == _CIRCULANT_CHUNK_BYTES // (n * 4)
+        # f64 (itemsize 8) still scales down, and tiny programs still get
+        # the single-chunk exact path.
+        assert _p_chunk_len(n, p, 8) == _CIRCULANT_CHUNK_BYTES // (n * 8)
+        assert _p_chunk_len(4, 128, 2) == 128

@@ -1,0 +1,233 @@
+"""Runtime sanitizer tests (analysis/sanitizers.py) and their Network
+wiring (tpu.recompile_guard / tpu.transfer_guard — core/network.py).
+
+Includes the ISSUE-1 acceptance run: a 20-node Krum round loop on the
+simulation backend under the recompile sanitizer, with zero post-warmup
+compiles.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from murmura_tpu.analysis.sanitizers import (
+    RecompileError,
+    track_compiles,
+    transfer_sanitizer,
+)
+
+
+class TestCompileTracker:
+    def test_counts_compiles_and_cache_hits(self):
+        f = jax.jit(lambda x: x * 3.0 + 1.0)
+        with track_compiles() as t:
+            f(jnp.ones(7))  # compile
+            first = t.total
+            f(jnp.ones(7))  # cache hit
+            assert t.total == first
+            f(jnp.ones(9))  # new shape -> recompile
+            assert t.total > first
+        assert first >= 1
+
+    def test_end_raises_on_unexpected_compile(self):
+        f = jax.jit(lambda x: x - 5.0)
+        with track_compiles() as t:
+            t.begin("round 0")
+            f(jnp.ones(11))
+            t.end(allow=True)  # warmup: compile expected
+            t.begin("round 1")
+            f(jnp.ones(11))
+            assert t.end(allow=False) == 0  # cache hit: fine
+            t.begin("round 2")
+            f(jnp.ones(13))  # shape drift -> recompile
+            with pytest.raises(RecompileError) as ei:
+                t.end(allow=False)
+            assert "round 2" in str(ei.value)
+        assert [label for label, _ in t.per_round] == [
+            "round 0", "round 1", "round 2",
+        ]
+
+    def test_mark_checks_subphases_independently(self):
+        """A bracket spanning two programs: each phase's warmup state is
+        checked on its own, so one phase's warmup cannot whitelist a
+        post-warmup recompile in the other."""
+        f = jax.jit(lambda x: x * 2.0)
+        g = jax.jit(lambda x: x / 2.0)
+        with track_compiles() as t:
+            t.begin("round 0")
+            f(jnp.ones(5))
+            t.mark(allow=True)
+            g(jnp.ones(5))
+            t.end(allow=True)
+            t.begin("round 1")
+            f(jnp.ones(5))
+            assert t.mark(allow=False) == 0  # cache hit: fine
+            g(jnp.ones(6))  # shape drift in the second phase
+            with pytest.raises(RecompileError):
+                t.end(allow=False)
+            t.begin("round 2")
+            f(jnp.ones(7))  # drift in the first phase
+            with pytest.raises(RecompileError):
+                t.mark(allow=False)  # allow=True on end must not mask this
+
+    def test_end_without_begin_raises(self):
+        with track_compiles() as t:
+            with pytest.raises(RuntimeError):
+                t.end()
+
+
+class TestTransferSanitizer:
+    def test_implicit_transfer_raises(self):
+        f = jax.jit(lambda x: x + 1.0)
+        f(jnp.ones(3))  # warm outside the guard
+        with transfer_sanitizer():
+            with pytest.raises(Exception, match="[Dd]isallowed"):
+                f(np.ones(3, np.float32))  # numpy arg -> implicit H2D
+
+    def test_explicit_transfers_pass(self):
+        f = jax.jit(lambda x: x + 1.0)
+        with transfer_sanitizer():
+            x = jnp.asarray(np.ones(3, np.float32))  # explicit H2D
+            y = f(x)
+            out = jax.device_get(y)  # explicit D2H
+        np.testing.assert_allclose(out, 2.0)
+
+
+def _krum_config(rounds=6, rounds_per_dispatch=1):
+    from murmura_tpu.config import Config
+
+    return Config.model_validate({
+        "experiment": {"name": "sanitizer-accept", "seed": 5,
+                       "rounds": rounds},
+        "topology": {"type": "ring", "num_nodes": 20},
+        "aggregation": {"algorithm": "krum",
+                        "params": {"num_compromised": 2}},
+        "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.1},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 400, "input_dim": 8,
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 8, "hidden_dims": [16],
+                             "num_classes": 3}},
+        "backend": "simulation",
+        "tpu": {"recompile_guard": True, "transfer_guard": True,
+                "rounds_per_dispatch": rounds_per_dispatch},
+    })
+
+
+class TestNetworkWiring:
+    def test_krum_20_nodes_zero_postwarmup_compiles(self):
+        """ISSUE-1 acceptance: 20-node Krum on the simulation backend runs
+        a multi-round loop under the recompile sanitizer with zero compiles
+        after round 0 (and under transfer_guard throughout)."""
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(_krum_config())
+        hist = net.train(rounds=6, eval_every=1)
+        assert hist["round"] == [1, 2, 3, 4, 5, 6]
+        report = net.last_compile_report
+        assert report is not None and len(report) == 6
+        warmup_compiles = report[0][1]
+        post_warmup = [c for _, c in report[1:]]
+        assert warmup_compiles >= 1  # round 0 really compiled the programs
+        assert post_warmup == [0] * 5
+        # Stats flow through unharmed (krum_score etc.).
+        assert any(k.startswith("agg_") for k in hist)
+
+    def test_fused_dispatch_tail_chunk_is_warmup(self):
+        """5 rounds at 2/dispatch: chunks of 2, 2, 1 — the length-1 tail is
+        a different program and its compile must count as warmup, not a
+        violation."""
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(
+            _krum_config(rounds=5, rounds_per_dispatch=2)
+        )
+        hist = net.train(rounds=5, eval_every=1, rounds_per_dispatch=2)
+        assert hist["round"] == [1, 2, 3, 4, 5]
+        report = net.last_compile_report
+        assert len(report) == 3
+        assert report[1][1] == 0  # second 2-round chunk: cache hit
+
+    def test_fused_guard_raise_leaves_state_consistent(self):
+        """A guard raise in a fused chunk must not desync bookkeeping from
+        the already-advanced (donated) params: round counter and history
+        reflect the executed chunk."""
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(
+            _krum_config(rounds=4, rounds_per_dispatch=2)
+        )
+        net.train(rounds=2, eval_every=1, rounds_per_dispatch=2)
+        assert net.current_round == 2
+        for prog in net._fused_cache.values():
+            prog.clear_cache()
+        with pytest.raises(RecompileError):
+            net.train(rounds=2, eval_every=1, rounds_per_dispatch=2)
+        assert net.current_round == 4
+        assert net.history["round"] == [1, 2, 3, 4]
+
+    def test_recompile_guard_fires_on_cache_clear(self):
+        """Force a post-warmup recompile (cleared jit cache) and assert the
+        guard converts it into a loud RecompileError."""
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(_krum_config())
+        net.train(rounds=2, eval_every=1)
+        net._step.clear_cache()
+        with pytest.raises(RecompileError, match="after\\s+warmup"):
+            net.train(rounds=2, eval_every=1)
+
+    def test_step_recompile_on_first_eval_round_still_fires(self):
+        """A step recompile landing on the round where eval first runs must
+        still raise: eval's warmup covers only the eval phase, not the
+        whole bracket."""
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(_krum_config())
+        net.train(rounds=4, eval_every=5)  # step warmed, eval not yet
+        net._step.clear_cache()
+        with pytest.raises(RecompileError, match="after\\s+warmup"):
+            net.train(rounds=1, eval_every=5)  # round 5: first eval round
+
+    def test_stage_multihost_skips_device_put(self, monkeypatch):
+        """On multi-host runs _stage must keep the jit in_shardings staging
+        path: device_put to a non-addressable sharding is a blocking
+        cross-process broadcast per call (and unsupported on some
+        backends)."""
+        from murmura_tpu.core import network as network_mod
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(_krum_config())
+
+        class _ExplodingSharding:
+            def __getattr__(self, name):
+                raise AssertionError("device_put must not see this sharding")
+
+        monkeypatch.setattr(network_mod.jax, "process_count", lambda: 2)
+        out = net._stage(np.ones(3, np.float32), _ExplodingSharding())
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+
+    def test_guards_off_by_default(self):
+        from murmura_tpu.config import Config
+
+        cfg = Config.model_validate({
+            "experiment": {"name": "defaults", "seed": 0, "rounds": 1},
+            "topology": {"type": "ring", "num_nodes": 4},
+            "aggregation": {"algorithm": "fedavg"},
+            "training": {"batch_size": 8},
+            "data": {"adapter": "synthetic",
+                     "params": {"num_samples": 64, "input_dim": 4,
+                                "num_classes": 2}},
+            "model": {"factory": "mlp",
+                      "params": {"input_dim": 4, "hidden_dims": [8],
+                                 "num_classes": 2}},
+        })
+        assert cfg.tpu.recompile_guard is False
+        assert cfg.tpu.transfer_guard is False
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        net = build_network_from_config(cfg)
+        net.train(rounds=1)
+        assert net.last_compile_report is None

@@ -59,6 +59,23 @@ class TestDirectedDeviation:
             expected = -5.0 if atk.compromised[i] else 1.0
             np.testing.assert_allclose(out[i], expected)
 
+    def test_registry_name(self):
+        """The config-visible name "directed_deviation" (ATTACKS registry /
+        schema enum) builds the same attack the factory helper does."""
+        from murmura_tpu.attacks import ATTACKS
+
+        atk = ATTACKS["directed_deviation"](
+            num_nodes=3, attack_percentage=0.34, lambda_param=-5.0, seed=0
+        )
+        ref = make_directed_deviation_attack(3, 0.34, lambda_param=-5.0, seed=0)
+        assert np.array_equal(atk.compromised, ref.compromised)
+        flat = jnp.ones((3, 8))
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        np.testing.assert_allclose(
+            np.asarray(atk.apply(flat, comp, None, 0)),
+            np.asarray(ref.apply(flat, comp, None, 0)),
+        )
+
 
 class TestTopologyLiar:
     def test_false_claims_add_coalition(self):

@@ -1,6 +1,7 @@
 """CLI surface via click's test runner (reference: murmura/cli.py:34-308)."""
 
 import json
+from pathlib import Path
 
 import yaml
 from click.testing import CliRunner
@@ -85,3 +86,45 @@ def test_list_components():
     for frag in ("fedavg", "krum", "evidential_trust", "gaussian",
                  "simulation", "ring"):
         assert frag in result.output
+
+
+def test_check_flags_seeded_violation(tmp_path):
+    """`murmura check <file>`: non-zero exit + greppable finding lines on a
+    file seeding a traced-branch and a host-sync violation."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return float(x)\n"
+        "    return x\n"
+    )
+    result = CliRunner().invoke(app, ["check", str(bad), "--no-contracts"])
+    assert result.exit_code == 1
+    assert "MUR001" in result.output
+    assert "MUR003" in result.output
+    assert f"{bad}:5:" in result.output  # path:line: greppable format
+
+
+def test_check_clean_file_exits_zero(tmp_path):
+    good = tmp_path / "good.py"
+    good.write_text(
+        "import jax\n\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x * 2\n"
+    )
+    result = CliRunner().invoke(app, ["check", str(good), "--no-contracts"])
+    assert result.exit_code == 0
+    assert "clean" in result.output
+
+
+def test_check_package_is_clean():
+    """The committed package must pass its own analyzer (with contracts) —
+    the same gate run_tpu_battery.sh uses as a pre-flight."""
+    import murmura_tpu
+
+    pkg = str(Path(murmura_tpu.__file__).resolve().parent)
+    result = CliRunner().invoke(app, ["check", pkg])
+    assert result.exit_code == 0, result.output

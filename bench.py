@@ -172,11 +172,16 @@ def main():
         # Cost analysis runs here (AOT, nothing executes) so the network —
         # and its resident [N, P] device state — can be dropped before the
         # next variant builds; holding both variants' buffers would add
-        # HBM pressure during the second timed measurement.
-        flops = None
+        # HBM pressure during the second timed measurement.  flops AND
+        # bytes are recorded so every BENCH_r*.json carries the same cost
+        # line the `murmura check --ir` budget sweep gates on
+        # (analysis/budgets.py) — drift between committed budgets and the
+        # bench's own cost line is then visible in one diff.
+        flops = bytes_accessed = None
         try:
             cost = network.step_cost_analysis()
             flops = float(cost.get("flops", 0.0)) or None
+            bytes_accessed = float(cost.get("bytes accessed", 0.0)) or None
         except Exception:
             pass
         return {
@@ -186,6 +191,7 @@ def main():
             "steady_warmup_s": round(warmup_s, 2),
             "elapsed": elapsed,
             "flops": flops,
+            "bytes_accessed": bytes_accessed,
         }
 
     # Headline config (float32 resident params) plus — on the chip — the
@@ -246,7 +252,11 @@ def main():
                     "lever_error": lever_error,
                     "north_star_256node": north_star,
                     "north_star_error": north_star_error,
+                    # The cost line per run: XLA's own AOT cost model for
+                    # the per-round program — the runtime twin of the
+                    # committed analysis/BUDGETS.json sweep.
                     "flops_per_round": flops,
+                    "bytes_accessed_per_round": best["bytes_accessed"],
                     "mfu": mfu,
                 }
             ),

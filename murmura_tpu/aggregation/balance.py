@@ -100,4 +100,13 @@ def make_balance(
         }
         return new_flat, state, stats
 
-    return AggregatorDef(name="balance", aggregate=aggregate)
+    return AggregatorDef(
+        name="balance",
+        aggregate=aggregate,
+        # MUR202: dense distance filter + accepted mean gather; circulant
+        # path is rolls only.
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"ppermute"},
+        },
+    )

@@ -21,13 +21,33 @@ Everything is traced — the rule compiles into the jitted round step.
 """
 
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Collection,
+    Dict,
+    FrozenSet,
+    Mapping,
+    Optional,
+    Tuple,
+)
 
 import jax
 import jax.numpy as jnp
 
 Stats = Dict[str, jnp.ndarray]
 AggState = Dict[str, jnp.ndarray]
+
+# Canonical names of the communication primitives a lowered aggregation
+# program may contain (the vocabulary of ``AggregatorDef.collectives`` and
+# of the MUR202 collective-inventory check, analysis/ir.py).  They mirror
+# the XLA HLO ops GSPMD emits when the node axis is sharded: the dense
+# rules' gathered [N, P] reads become ``all_gather``/``all_reduce``; the
+# circulant rules' ``jnp.roll`` becomes boundary ``ppermute``
+# (collective-permute); vmapped probe sweeps may add ``all_to_all``.
+COLLECTIVE_NAMES = frozenset(
+    {"all_gather", "all_reduce", "ppermute", "all_to_all", "reduce_scatter"}
+)
 
 
 @dataclass(frozen=True)
@@ -75,6 +95,16 @@ class AggregatorDef:
     'node' = leading axis is the node id (e.g. acceptance windows), 'edge' =
     [N, N] directed-edge matrix (e.g. smoothed trust).  The ZMQ distributed
     backend uses this to project the stacked state onto one process's view.
+
+    ``collectives`` declares the rule's communication contract: for each
+    exchange mode ('dense' = gathered [N, P] adjacency masking, 'circulant'
+    = tpu.exchange: ppermute rolls) the set of :data:`COLLECTIVE_NAMES`
+    the lowered SPMD program is allowed to contain.  ``murmura check --ir``
+    (MUR202, analysis/ir.py) compiles each rule over a sharded node axis
+    and fails on any collective outside the declaration — a stray
+    ``all_gather`` on the circulant path is a finding at check time, not a
+    silent O(N) ICI regression on the chip.  ``None`` means undeclared,
+    itself a finding for registered rules.
     """
 
     name: str
@@ -85,6 +115,16 @@ class AggregatorDef:
     init_state: Callable[[int], AggState] = field(default=lambda num_nodes: {})
     needs_probe: bool = False
     state_kind: Dict[str, str] = field(default_factory=dict)
+    collectives: Optional[Mapping[str, Collection[str]]] = None
+
+    def declared_collectives(self, circulant: bool) -> Optional[FrozenSet[str]]:
+        """Allowed collective set for one exchange mode (``None`` =
+        undeclared).  The hook the IR analyzer calls; values must be drawn
+        from :data:`COLLECTIVE_NAMES`."""
+        if self.collectives is None:
+            return None
+        mode = "circulant" if circulant else "dense"
+        return frozenset(self.collectives.get(mode, ()))
 
 
 # ---------------------------------------------------------------------------
@@ -359,9 +399,15 @@ def circulant_masked_mean(
         bcast: [N, P] broadcast states.
         accept_k: [k, N] accept weight for node i's neighbor at offset o.
     """
-    acc = circulant_weighted_sum(bcast, accept_k, offsets)
+    # Normalize the small [k, N] weights up front (full f32 precision) and
+    # pin out_dtype to the resident param dtype: per-chunk accumulation
+    # still runs at the promoted f32 precision inside the shared kernel,
+    # but no full-size f32 [N, P] accumulator or quotient is ever
+    # materialized (the OOM class out_dtype exists for) and the exchanged
+    # tensor never upcasts (MUR201).
     cnt = accept_k.sum(axis=0)
-    return acc / jnp.maximum(cnt, 1e-12)[:, None]
+    w_norm = accept_k / jnp.maximum(cnt, 1e-12)[None, :]
+    return circulant_weighted_sum(bcast, w_norm, offsets, out_dtype=bcast.dtype)
 
 
 def candidate_indices(adj: jnp.ndarray, m_cap: int):
@@ -382,9 +428,21 @@ def candidate_indices(adj: jnp.ndarray, m_cap: int):
 
 
 def masked_neighbor_mean(bcast: jnp.ndarray, weights: jnp.ndarray) -> jnp.ndarray:
-    """Weighted neighbor mean per node: (W @ bcast) / row-sum, safe on empty rows."""
-    totals = weights.sum(axis=1, keepdims=True)
-    return (weights @ bcast) / jnp.maximum(totals, 1e-12)
+    """Weighted neighbor mean per node: (W @ bcast) / row-sum, safe on empty rows.
+
+    Dtype-stable by contract (MUR201): with bf16 resident params the matmul
+    runs bf16-in/f32-accumulate (the MXU-native mode — f32 *operands* would
+    double the memory-bound matmul's HBM reads) and the mean is cast back to
+    the resident dtype, so the exchanged [N, P] tensor never upcasts.  Row
+    totals are summed (in f32) from the SAME cast weights the matmul uses:
+    normalizing a bf16-quantized numerator by the unquantized f32 total
+    would scale every row by sum(w)/sum(bf16(w)) != 1 — a systematic bias
+    applied to the parameters each round.
+    """
+    w = weights.astype(bcast.dtype)
+    totals = w.sum(axis=1, keepdims=True, dtype=jnp.float32)
+    acc = jnp.dot(w, bcast, preferred_element_type=jnp.float32)
+    return (acc / jnp.maximum(totals, 1e-12)).astype(bcast.dtype)
 
 
 def blend_with_own(

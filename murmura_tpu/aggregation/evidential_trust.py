@@ -41,6 +41,7 @@ from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     circulant_weighted_sum,
+    masked_neighbor_mean,
 )
 from murmura_tpu.aggregation.probe import (
     circulant_probe_eval,
@@ -146,7 +147,12 @@ def make_evidential_trust(
         has_accepted = total > 0
         norm_w = weights / jnp.maximum(total, 1e-12)[None, :]
 
-        neighbor_agg = circulant_weighted_sum(bcast, norm_w, offsets)
+        # out_dtype: per-chunk accumulation stays at the promoted f32
+        # precision, only the stored blend returns to the resident param
+        # dtype (MUR201 — the exchanged [N, P] tensor must not upcast).
+        neighbor_agg = circulant_weighted_sum(
+            bcast, norm_w, offsets, out_dtype=own.dtype
+        )
         blended = self_weight * own + (1.0 - self_weight) * neighbor_agg
         new_flat = jnp.where(has_accepted[:, None], blended, own)
 
@@ -219,8 +225,12 @@ def make_evidential_trust(
         has_accepted = total > 0
 
         # Phase 3: trust-normalized neighbor mean + personalization blend.
-        norm_weights = weights / jnp.maximum(total, 1e-12)[:, None]
-        neighbor_agg = norm_weights @ bcast
+        # masked_neighbor_mean owns the dtype discipline (MUR201): bf16
+        # matmul operands with f32 accumulation, normalized by the SAME
+        # cast weights the matmul uses (normalizing first and casting after
+        # would scale rows by sum(w)/sum(bf16(w)) != 1), stored back in the
+        # resident param dtype.
+        neighbor_agg = masked_neighbor_mean(bcast, weights)
         blended = self_weight * own + (1.0 - self_weight) * neighbor_agg
         new_flat = jnp.where(has_accepted[:, None], blended, own)
 
@@ -241,4 +251,14 @@ def make_evidential_trust(
         init_state=init_state,
         needs_probe=True,
         state_kind={"smoothed_trust": "edge", "trust_seen": "edge"},
+        # MUR202: the dense trust probe cross-evaluates exchanged states
+        # (vmapped forwards GSPMD decomposes into gather/all-to-all over
+        # the small probe batches).  The circulant mode still gathers the
+        # [N, N] *edge-indexed* smoothed-trust state (its scatter/gather is
+        # O(N*k), not O(N*P)) — only the heavy [N, P] blend must stay
+        # ppermute.
+        collectives={
+            "dense": {"all_gather", "all_reduce", "all_to_all"},
+            "circulant": {"all_gather", "all_reduce", "ppermute"},
+        },
     )

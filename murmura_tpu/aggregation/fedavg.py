@@ -32,11 +32,37 @@ def make_fedavg(
         if offsets is not None:
             # roll(bcast, -o)[i] == bcast[(i+o) % N]: node i's neighbor at
             # circulant offset o; the shared kernel chunks P at large N*P.
-            ones = jnp.ones((len(offsets), own.shape[0]), bcast.dtype)
-            neighbor_sum = circulant_weighted_sum(bcast, ones, offsets)
+            # f32 weights force f32 per-chunk accumulation over the k adds
+            # (matching the dense branch's preferred_element_type) while
+            # out_dtype keeps the stored sum — and any chunked [N, P]
+            # buffer — in the resident param dtype.
+            ones = jnp.ones((len(offsets), own.shape[0]), jnp.float32)
+            neighbor_sum = circulant_weighted_sum(
+                bcast, ones, offsets, out_dtype=own.dtype
+            )
         else:
-            neighbor_sum = adj @ bcast
-        new_flat = (own + neighbor_sum) / (1.0 + degree)[:, None]
+            # bf16 operands with f32 accumulation (MXU-native); an f32 adj
+            # operand would promote the gathered [N, P] tensor before the
+            # matmul and double its HBM reads (MUR201).
+            neighbor_sum = jnp.dot(
+                adj.astype(bcast.dtype), bcast,
+                preferred_element_type=jnp.float32,
+            )
+        # The 1/(1+degree) weights stay f32; only the stored mean returns
+        # to the resident param dtype so the exchange never upcasts.
+        new_flat = ((own + neighbor_sum) / (1.0 + degree)[:, None]).astype(
+            own.dtype
+        )
         return new_flat, state, {"num_neighbors": degree}
 
-    return AggregatorDef(name="fedavg", aggregate=aggregate)
+    return AggregatorDef(
+        name="fedavg",
+        aggregate=aggregate,
+        # MUR202 contract: the dense mean is one gathered matmul; the
+        # circulant path must stay boundary ppermutes — an all_gather there
+        # is the exact regression tpu.exchange: ppermute exists to avoid.
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"ppermute"},
+        },
+    )

@@ -167,4 +167,10 @@ def make_krum(
     return AggregatorDef(
         name="krum",
         aggregate=aggregate if offsets is None else aggregate_circulant,
+        # MUR202: the dense Gram/selection gathers; the O(degree) circulant
+        # selection must stay boundary ppermutes (the north-star invariant).
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"ppermute"},
+        },
     )

@@ -121,6 +121,12 @@ def make_coordinate_median(
     return AggregatorDef(
         name="median",
         aggregate=aggregate if offsets is None else aggregate_circulant,
+        # MUR202: candidate-stack rules — dense gathers the [N, P] stack,
+        # the circulant stack is rolls only.
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"ppermute"},
+        },
     )
 
 
@@ -192,6 +198,12 @@ def make_trimmed_mean(
     return AggregatorDef(
         name="trimmed_mean",
         aggregate=aggregate if offsets is None else aggregate_circulant,
+        # MUR202: candidate-stack rules — dense gathers the [N, P] stack,
+        # the circulant stack is rolls only.
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"ppermute"},
+        },
     )
 
 
@@ -381,4 +393,10 @@ def make_geometric_median(
     return AggregatorDef(
         name="geometric_median",
         aggregate=aggregate if offsets is None else aggregate_circulant,
+        # MUR202: candidate-stack rules — dense gathers the [N, P] stack,
+        # the circulant stack is rolls only.
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"ppermute"},
+        },
     )

@@ -116,4 +116,11 @@ def make_sketchguard(
         aggregate=aggregate,
         init_state=init_state,
         state_kind={"acc_window": "node", "window_len": "node"},
+        # MUR202: the distance filter runs in dense *sketch* space ([N, S],
+        # S << P) by design, so even the circulant mode gathers/reduces the
+        # small sketches — only the heavy [N, P] mean must stay ppermute.
+        collectives={
+            "dense": {"all_gather", "all_reduce"},
+            "circulant": {"all_gather", "all_reduce", "ppermute"},
+        },
     )

@@ -134,4 +134,17 @@ def make_ubar(
         }
         return new_flat, state, stats
 
-    return AggregatorDef(name="ubar", aggregate=aggregate, needs_probe=True)
+    return AggregatorDef(
+        name="ubar",
+        aggregate=aggregate,
+        needs_probe=True,
+        # MUR202: the dense mode cross-evaluates exchanged states (vmapped
+        # probe forwards GSPMD decomposes into gather/all-to-all over the
+        # small probe batches); the circulant mode is rolls ONLY — probe
+        # data stays node-local, so even the stage-2 loss probe must lower
+        # to boundary ppermutes.
+        collectives={
+            "dense": {"all_gather", "all_reduce", "all_to_all"},
+            "circulant": {"ppermute"},
+        },
+    )

@@ -1,16 +1,23 @@
 """Static analysis + runtime sanitizers for the murmura_tpu codebase.
 
 ``python -m murmura_tpu check [path]`` — a JAX-aware lint pass (see
-:mod:`murmura_tpu.analysis.lint`) plus cross-layer contract checks
-(:mod:`murmura_tpu.analysis.contracts`).  The runtime sanitizers
-(:mod:`murmura_tpu.analysis.sanitizers`) are opt-in guards wired into the
-round loop behind ``tpu.recompile_guard`` / ``tpu.transfer_guard``.
+:mod:`murmura_tpu.analysis.lint`), cross-layer contract checks
+(:mod:`murmura_tpu.analysis.contracts`), and — for the package check — the
+jaxpr/HLO-level IR contracts (:mod:`murmura_tpu.analysis.ir`, MUR200-205)
+plus committed AOT cost budgets (:mod:`murmura_tpu.analysis.budgets`,
+MUR206).  The runtime sanitizers (:mod:`murmura_tpu.analysis.sanitizers`)
+are opt-in guards wired into the round loop behind ``tpu.recompile_guard``
+/ ``tpu.transfer_guard``.
 
-Rationale (round-5 verdict): the framework's correctness rests on
-non-local invariants the type system cannot see — zero-diagonal adjacency,
-registry/schema/test sync, no host syncs or recompiles inside the round
-hot path.  ``check`` turns each into a machine-checked contract.  See
-docs/ANALYSIS.md for the rule catalogue and suppression syntax.
+Rationale (round-5 verdict + ISSUE 2): the framework's correctness rests
+on non-local invariants the type system cannot see — zero-diagonal
+adjacency, registry/schema/test sync, no host syncs or recompiles inside
+the round hot path — and its *performance* rests on IR-level invariants
+the AST can only approximate: collective inventory, dtype discipline
+through the dataflow, donation, shape-stable programs, and each
+aggregator's FLOPs/bytes envelope.  ``check`` turns each into a
+machine-checked contract.  See docs/ANALYSIS.md for the rule catalogue and
+suppression syntax.
 """
 
 from murmura_tpu.analysis.lint import Finding, lint_file, lint_paths
@@ -22,25 +29,57 @@ from murmura_tpu.analysis.sanitizers import (
     transfer_sanitizer,
 )
 
+import json
 from pathlib import Path
-from typing import Iterable, List, Optional, Sequence
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple
 
 
-def run_check(
-    paths: Optional[Sequence] = None, contracts: bool = True
-) -> List[Finding]:
-    """Run the full static pass: AST lint over ``paths`` (default: the
-    installed murmura_tpu package) plus the cross-layer contract checks.
+def run_check_detailed(
+    paths: Optional[Sequence] = None,
+    contracts: bool = True,
+    ir: Optional[bool] = None,
+    budget_path=None,
+) -> Tuple[List[Finding], List[Dict[str, Any]]]:
+    """Run the full static pass and return ``(findings, budget_deltas)``.
 
-    Returns all findings sorted by (path, line); empty means clean.
+    The pass layers: AST lint over ``paths`` (default: the installed
+    murmura_tpu package), the cross-layer contract checks, and — when
+    ``ir`` is enabled — the jaxpr/HLO IR contracts (analysis/ir.py,
+    MUR200-205) plus the AOT cost-budget sweep (analysis/budgets.py,
+    MUR206).  ``ir=None`` means "on for the package check, off for
+    explicit paths" (the IR pass is package-global: it traces the live
+    registry, not the files named on the command line).
+
+    ``budget_deltas`` carries one record per budget grid cell (measured vs
+    committed flops/bytes, including in-tolerance cells) and is empty when
+    the IR pass does not run.
     """
+    run_ir = ir if ir is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
     if contracts:
         findings.extend(check_contracts())
+    deltas: List[Dict[str, Any]] = []
+    if run_ir:
+        from murmura_tpu.analysis import budgets as budgets_mod
+        from murmura_tpu.analysis import ir as ir_mod
+
+        findings.extend(ir_mod.check_ir())
+        budget_findings, deltas = budgets_mod.check_budgets(budget_path)
+        findings.extend(budget_findings)
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings
+    return findings, deltas
+
+
+def run_check(
+    paths: Optional[Sequence] = None,
+    contracts: bool = True,
+    ir: Optional[bool] = None,
+) -> List[Finding]:
+    """Findings-only wrapper of :func:`run_check_detailed` (the historical
+    API; empty result means clean)."""
+    return run_check_detailed(paths, contracts=contracts, ir=ir)[0]
 
 
 def format_findings(findings: Iterable[Finding]) -> str:
@@ -50,13 +89,41 @@ def format_findings(findings: Iterable[Finding]) -> str:
     )
 
 
+def format_findings_json(
+    findings: Iterable[Finding],
+    budget_deltas: Optional[Iterable[Dict[str, Any]]] = None,
+) -> str:
+    """JSON-lines rendering for editors/CI (``check --json``): one
+    ``{"kind": "finding", ...}`` object per finding followed by one
+    ``{"kind": "budget_delta", ...}`` object per budget grid cell."""
+    lines = [
+        json.dumps(
+            {
+                "kind": "finding",
+                "rule": f.rule,
+                "name": f.name,
+                "path": f.path,
+                "line": f.line,
+                "message": f.message,
+                **({"data": f.data} if f.data else {}),
+            }
+        )
+        for f in findings
+    ]
+    for rec in budget_deltas or ():
+        lines.append(json.dumps({"kind": "budget_delta", **rec}))
+    return "\n".join(lines)
+
+
 __all__ = [
     "Finding",
     "lint_file",
     "lint_paths",
     "check_contracts",
     "run_check",
+    "run_check_detailed",
     "format_findings",
+    "format_findings_json",
     "CompileTracker",
     "RecompileError",
     "track_compiles",

@@ -1,0 +1,725 @@
+"""jaxpr/HLO-level IR contracts (MUR200-205) — ``murmura check --ir``.
+
+The AST pass (analysis/lint.py) can only *approximate* what a traced scope
+does; the jaxpr and the AOT-compiled executable show what it actually does.
+The invariants the north star lives on — no host round-trips inside the
+round program, bf16 exchange tensors that stay bf16, masked exchange that
+lowers to boundary ppermutes instead of an all-gather, one compiled program
+per shape family, donated round buffers that are actually donated — are
+only visible at this level, so each is enforced here as a machine-checked
+contract over a canonical (n_nodes x model_dim x dtype) grid:
+
+====== ===================== ==============================================
+rule   name                  contract
+====== ===================== ==============================================
+MUR200 ir-host-callback      no ``pure_callback``/``io_callback``/
+                             ``jax.debug.*`` callback primitive anywhere in
+                             an aggregation jaxpr — each is a device→host
+                             round-trip serializing the round hot path.
+MUR201 ir-dtype-discipline   dataflow dtype truth behind AST rule MUR006:
+                             the aggregated [N, P] tensor and carried state
+                             keep their input dtypes (bf16 in → bf16 out);
+                             in bf16 programs no matmul takes a full-size
+                             f32 operand (f32 belongs in *accumulation* —
+                             ``preferred_element_type`` — not operands);
+                             float64 appears nowhere.
+MUR202 ir-collective-inventory
+                             the communication primitives in the lowered
+                             SPMD program are a subset of the rule's
+                             ``declared_collectives()``
+                             (aggregation/base.py); a stray all_gather on a
+                             circulant path is a finding, not an ICI
+                             surprise.  Undeclared rules are findings.
+MUR203 ir-shape-polymorphism jaxprs traced at two different n are
+                             structurally identical (same primitive tree) —
+                             a rule whose *program* changes with n would
+                             recompile per network size beyond the
+                             unavoidable shape specialization.
+MUR204 ir-donation           buffers the round step marks donated are
+                             actually aliased in the compiled executable
+                             (params + carried aggregation state) — a lost
+                             alias is a silent extra [N, P] HBM copy per
+                             round.
+MUR205 ir-coverage           every registry aggregator has a canonical IR
+                             case (the MUR101-style bijection that keeps
+                             MUR200-203 from going vacuous for new rules).
+====== ===================== ==============================================
+
+Suppression: IR findings anchor to the rule's factory (``def make_*``)
+line, so the ordinary line suppression applies there, e.g.
+``def make_fedavg(...):  # murmura: ignore[MUR202]``.
+"""
+
+import dataclasses
+import inspect
+import os
+import re
+import warnings
+from pathlib import Path
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+from murmura_tpu.analysis.lint import Finding, _suppressed
+
+# --------------------------------------------------------------------------
+# Canonical grid
+# --------------------------------------------------------------------------
+
+# Two network sizes: MUR200-202 run at the first, MUR203 compares the two.
+IR_NODE_COUNTS: Tuple[int, int] = (8, 12)
+# Flat parameter dimension for rules that never run the model; probe-based
+# rules use the canonical probe model's own dimension instead.
+IR_MODEL_DIM = 256
+_PROBE_IN = 8
+_PROBE_BATCH = 8
+_PROBE_CLASSES = 4
+
+# Canonical constructor params per registry rule — the IR twin of the
+# contracts pass's _TOPOLOGY_CASES.  MUR205 enforces the bijection with
+# aggregation.AGGREGATORS, so a new rule cannot land without an IR case
+# (and therefore without MUR200-203 coverage and a cost budget).
+AGG_CASES: Dict[str, Dict[str, Any]] = {
+    "fedavg": {},
+    "krum": {"num_compromised": 1},
+    "balance": {},
+    "sketchguard": {"sketch_size": 64},
+    "ubar": {},
+    "evidential_trust": {},
+    "median": {},
+    "trimmed_mean": {},
+    "geometric_median": {"max_iters": 4},
+}
+
+# Rules that evaluate the model on probe batches (AggContext.apply_fn).
+_PROBE_RULES = frozenset({"ubar", "evidential_trust"})
+
+# HLO op → canonical collective name (aggregation.base.COLLECTIVE_NAMES).
+# -start variants cover async collectives on backends that split them.
+_HLO_COLLECTIVES = {
+    "all-gather": "all_gather",
+    "all-gather-start": "all_gather",
+    "all-reduce": "all_reduce",
+    "all-reduce-start": "all_reduce",
+    "collective-permute": "ppermute",
+    "collective-permute-start": "ppermute",
+    "all-to-all": "all_to_all",
+    "reduce-scatter": "reduce_scatter",
+}
+_COLL_RE = re.compile(
+    r"\b(" + "|".join(sorted(_HLO_COLLECTIVES, key=len, reverse=True)) + r")\b"
+)
+
+_ALIAS_RE = re.compile(r"\b(?:may|must)-alias\b")
+
+
+def _ensure_host_devices(count: int = 8) -> None:
+    """Request a multi-device host platform for the MUR202 sharded
+    lowerings, when the XLA backend is not initialized yet (the CLI path;
+    tests get their devices from conftest.py).  A no-op afterwards —
+    backend flags cannot change post-init."""
+    from murmura_tpu.parallel.mesh import backend_initialized
+
+    if backend_initialized():
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={count}"
+        )
+
+
+# --------------------------------------------------------------------------
+# Canonical programs
+# --------------------------------------------------------------------------
+
+
+_PROBE_MODEL_MEMO = None
+
+
+def _probe_model():
+    """(apply_fn, unravel, dim) of the canonical probe model — a tiny MLP
+    shared by every probe-based rule's canonical program.  Memoized: the
+    init/ravel is constant per process and every build_canonical call for
+    a probe rule (plus every rule_model_dim) would otherwise re-run it."""
+    global _PROBE_MODEL_MEMO
+    if _PROBE_MODEL_MEMO is not None:
+        return _PROBE_MODEL_MEMO
+    import jax
+    from jax.flatten_util import ravel_pytree
+
+    from murmura_tpu.models import make_mlp
+
+    model = make_mlp(
+        input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+    )
+    flat0, unravel = ravel_pytree(model.init(jax.random.PRNGKey(0)))
+    _PROBE_MODEL_MEMO = (model.apply, unravel, int(flat0.size))
+    return _PROBE_MODEL_MEMO
+
+
+def rule_model_dim(name: str) -> int:
+    """Canonical flat dimension for one rule (probe rules carry the probe
+    model's parameter count; everything else uses IR_MODEL_DIM)."""
+    if name in _PROBE_RULES:
+        return _probe_model()[2]
+    return IR_MODEL_DIM
+
+
+def canonical_offsets(n: int) -> List[int]:
+    """Circulant offsets of the canonical k-regular(4) topology at size n —
+    derived from the real generator so the IR pass exercises each
+    topology's masked-exchange program, not a hand-typed stand-in."""
+    from murmura_tpu.topology.generators import create_topology
+
+    offsets = create_topology("k-regular", num_nodes=n, k=4).circulant_offsets()
+    if not offsets:
+        raise AssertionError(f"k-regular({n}) stopped being circulant")
+    return offsets
+
+
+def _canonical_adj(n: int, circulant: bool):
+    import numpy as np
+
+    from murmura_tpu.topology.generators import create_topology
+
+    if circulant:
+        adj = np.zeros((n, n), dtype=np.float32)
+        for o in canonical_offsets(n):
+            adj[np.arange(n), (np.arange(n) + o) % n] = 1.0
+        return adj
+    return create_topology("fully", num_nodes=n).mask()
+
+
+@dataclasses.dataclass
+class CanonicalProgram:
+    """One traceable aggregation cell of the canonical grid.
+
+    ``fn(*args)`` closes over the AggContext (static under trace) and takes
+    only array arguments, so it can be handed directly to ``make_jaxpr``,
+    ``eval_shape`` and sharded ``jit``.
+    """
+
+    name: str
+    n: int
+    dim: int
+    circulant: bool
+    fn: Callable
+    args: Tuple
+    arg_shardings: Callable  # (node_sharding, replicated) -> pytree of args
+    agg: Any = None  # the AggregatorDef (declared_collectives hook)
+
+
+def build_canonical(
+    name: str,
+    n: int,
+    dtype: str = "float32",
+    circulant: bool = False,
+    node_axis_sharded: bool = False,
+    params: Optional[Dict[str, Any]] = None,
+    dim: Optional[int] = None,
+) -> CanonicalProgram:
+    """Instantiate one rule over one grid cell.
+
+    Probe batches are explicit *arguments* (not closed-over constants) so
+    the MUR202 sharded lowering sees them node-sharded, exactly as the real
+    round program's data arrays are.  ``dim`` overrides the flat parameter
+    dimension for non-probe rules (the budgets sweep uses two sizes); probe
+    rules are pinned to the canonical probe model's own dimension.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.aggregation.base import AggContext
+
+    dt = jnp.dtype(dtype)
+    if dim is None or name in _PROBE_RULES:
+        dim = rule_model_dim(name)
+    case = dict(AGG_CASES.get(name, {}) if params is None else params)
+    if circulant:
+        case["exchange_offsets"] = canonical_offsets(n)
+    agg = build_aggregator(name, case, model_dim=dim, total_rounds=10)
+
+    rng = np.random.default_rng(0)
+    own = jnp.asarray(rng.normal(size=(n, dim)) * 0.1, dt)
+    bcast = jnp.asarray(rng.normal(size=(n, dim)) * 0.1, dt)
+    adj = jnp.asarray(_canonical_adj(n, circulant))
+    ridx = jnp.asarray(0.0, jnp.float32)
+    state = {k: jnp.asarray(v) for k, v in agg.init_state(n).items()}
+
+    base_ctx = AggContext(
+        total_rounds=10,
+        num_classes=_PROBE_CLASSES,
+        node_axis_sharded=node_axis_sharded,
+    )
+
+    if name in _PROBE_RULES:
+        apply_fn, unravel, _ = _probe_model()
+        probe = {
+            "x": jnp.asarray(
+                rng.normal(size=(n, _PROBE_BATCH, _PROBE_IN)), jnp.float32
+            ),
+            "y": jnp.asarray(
+                rng.integers(0, _PROBE_CLASSES, size=(n, _PROBE_BATCH)),
+                jnp.int32,
+            ),
+            "mask": jnp.ones((n, _PROBE_BATCH), jnp.float32),
+        }
+
+        def fn(own, bcast, adj, ridx, state, probe):  # murmura: traced
+            ctx = dataclasses.replace(
+                base_ctx,
+                apply_fn=apply_fn,
+                unravel=unravel,
+                probe_x=probe["x"],
+                probe_y=probe["y"],
+                probe_mask=probe["mask"],
+            )
+            return agg.aggregate(own, bcast, adj, ridx, state, ctx)
+
+        args = (own, bcast, adj, ridx, state, probe)
+
+        def arg_shardings(node_s, repl):
+            return (
+                node_s, node_s, node_s, repl,
+                {k: node_s for k in state},
+                {k: node_s for k in probe},
+            )
+
+    else:
+
+        def fn(own, bcast, adj, ridx, state):  # murmura: traced
+            return agg.aggregate(own, bcast, adj, ridx, state, base_ctx)
+
+        args = (own, bcast, adj, ridx, state)
+
+        def arg_shardings(node_s, repl):
+            return (node_s, node_s, node_s, repl, {k: node_s for k in state})
+
+    return CanonicalProgram(
+        name=name, n=n, dim=dim, circulant=circulant, fn=fn, args=args,
+        arg_shardings=arg_shardings, agg=agg,
+    )
+
+
+# --------------------------------------------------------------------------
+# jaxpr utilities
+# --------------------------------------------------------------------------
+
+
+def trace_jaxpr(prog: CanonicalProgram):
+    """The cell's ClosedJaxpr (tracing only — nothing compiles or runs)."""
+    import jax
+
+    return jax.make_jaxpr(prog.fn)(*prog.args)
+
+
+def iter_eqns(jaxpr) -> Iterator:
+    """All equations of a (Closed)Jaxpr, recursing into sub-jaxprs
+    (pjit/scan/while/cond branches, custom_* calls)."""
+    jx = getattr(jaxpr, "jaxpr", jaxpr)
+    for eqn in jx.eqns:
+        yield eqn
+        for sub in eqn.params.values():
+            subs = sub if isinstance(sub, (list, tuple)) else [sub]
+            for s in subs:
+                if hasattr(s, "jaxpr") or hasattr(s, "eqns"):
+                    yield from iter_eqns(s)
+
+
+def jaxpr_signature(jaxpr) -> Tuple[str, ...]:
+    """Structural signature: the depth-annotated primitive sequence.  Two
+    traces of the same rule at different n must produce identical
+    signatures (MUR203) — dimension constants change, the program must
+    not."""
+    sig: List[str] = []
+
+    def walk(jx, depth: int) -> None:
+        jx = getattr(jx, "jaxpr", jx)
+        for eqn in jx.eqns:
+            sig.append(f"{depth}:{eqn.primitive.name}")
+            for sub in eqn.params.values():
+                subs = sub if isinstance(sub, (list, tuple)) else [sub]
+                for s in subs:
+                    if hasattr(s, "jaxpr") or hasattr(s, "eqns"):
+                        walk(s, depth + 1)
+
+    walk(jaxpr, 0)
+    return tuple(sig)
+
+
+def collective_inventory(prog: CanonicalProgram, mesh=None) -> Optional[frozenset]:
+    """Canonical collective names in the cell's compiled SPMD program.
+
+    Compiles the cell with the node axis sharded over a >= 2 device mesh
+    (the tpu-backend layout, parallel/mesh.py) and scans the optimized HLO.
+    Returns ``None`` when no multi-device platform is available — the
+    inventory is then unobservable and MUR202 degrades with a warning.
+    """
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    import numpy as np
+
+    if mesh is None:
+        devices = jax.devices()
+        usable = [d for d in (2, 4, 8) if d <= len(devices) and prog.n % d == 0]
+        if not usable:
+            return None
+        mesh = Mesh(np.array(devices[: max(usable)]), ("nodes",))
+    node_s = NamedSharding(mesh, P("nodes"))
+    repl = NamedSharding(mesh, P())
+    jitted = jax.jit(prog.fn, in_shardings=prog.arg_shardings(node_s, repl))
+    txt = jitted.lower(*prog.args).compile().as_text()
+    return frozenset(_HLO_COLLECTIVES[m] for m in _COLL_RE.findall(txt))
+
+
+# --------------------------------------------------------------------------
+# Checks
+# --------------------------------------------------------------------------
+
+
+def _rule_anchor(name: str) -> Tuple[str, int]:
+    """(path, line) of the rule's factory ``def`` — where IR findings point
+    and where line suppressions apply."""
+    from murmura_tpu.aggregation import AGGREGATORS
+
+    fn = AGGREGATORS.get(name)
+    try:
+        path = inspect.getsourcefile(fn)
+        line = inspect.getsourcelines(fn)[1]
+        return str(path), int(line)
+    except (OSError, TypeError):
+        pkg = Path(__file__).resolve().parent.parent
+        return str(pkg / "aggregation" / "__init__.py"), 1
+
+
+def _mode(circulant: bool) -> str:
+    return "circulant" if circulant else "dense"
+
+
+def _check_callbacks(name: str, prog: CanonicalProgram, jaxpr) -> List[Finding]:
+    """MUR200: host callback primitives in the aggregation jaxpr."""
+    path, line = _rule_anchor(name)
+    found = sorted(
+        {
+            eqn.primitive.name
+            for eqn in iter_eqns(jaxpr)
+            if "callback" in eqn.primitive.name
+        }
+    )
+    if not found:
+        return []
+    return [Finding(
+        "MUR200", path, line,
+        f"aggregator '{name}' ({_mode(prog.circulant)}) traces host "
+        f"callback primitive(s) {found} into the round program — each is a "
+        "device->host round-trip serializing the hot path; remove the "
+        "jax.debug/pure_callback/io_callback call",
+    )]
+
+
+def _check_dtypes(
+    name: str, prog_f32: CanonicalProgram, prog_bf16: CanonicalProgram
+) -> List[Finding]:
+    """MUR201: dtype discipline through the dataflow (see module table)."""
+    import jax
+    import jax.numpy as jnp
+
+    path, line = _rule_anchor(name)
+    findings: List[Finding] = []
+    mode = _mode(prog_f32.circulant)
+
+    for prog, label in ((prog_f32, "float32"), (prog_bf16, "bfloat16")):
+        own, state = prog.args[0], prog.args[4]
+        out = jax.eval_shape(prog.fn, *prog.args)
+        new_flat, new_state, _stats = out
+        if new_flat.dtype != own.dtype:
+            findings.append(Finding(
+                "MUR201", path, line,
+                f"aggregator '{name}' ({mode}, {label} params) returns the "
+                f"aggregated [N, P] tensor as {new_flat.dtype} — the "
+                "exchanged state must keep the resident param dtype "
+                "(accumulate in f32, store in the input dtype)",
+            ))
+        for k, v in new_state.items():
+            if k in state and v.dtype != state[k].dtype:
+                findings.append(Finding(
+                    "MUR201", path, line,
+                    f"aggregator '{name}' ({mode}, {label} params) drifts "
+                    f"carried state '{k}' from {state[k].dtype} to "
+                    f"{v.dtype} — state dtypes must be round-stable",
+                ))
+
+    # f64 anywhere + full-size f32 matmul operands in the bf16 program.
+    jaxpr = trace_jaxpr(prog_bf16)
+    full = prog_bf16.n * prog_bf16.dim
+    f64_prims = set()
+    for eqn in iter_eqns(jaxpr):
+        for var in list(eqn.invars) + list(eqn.outvars):
+            aval = getattr(var, "aval", None)
+            dt = getattr(aval, "dtype", None)
+            if dt == jnp.float64:
+                f64_prims.add(eqn.primitive.name)
+            if (
+                eqn.primitive.name == "dot_general"
+                and var in eqn.invars
+                and dt == jnp.float32
+                and getattr(aval, "size", 0) >= full
+            ):
+                findings.append(Finding(
+                    "MUR201", path, line,
+                    f"aggregator '{name}' ({mode}, bfloat16 params) feeds a "
+                    f"full-size float32 operand {tuple(aval.shape)} into a "
+                    "matmul — promote via preferred_element_type (f32 "
+                    "accumulation over bf16 operands), not via f32 "
+                    "operands, which double the matmul's HBM reads",
+                ))
+    if f64_prims:
+        findings.append(Finding(
+            "MUR201", path, line,
+            f"aggregator '{name}' ({mode}) traces float64 values (via "
+            f"{sorted(f64_prims)[:4]}) — nothing in the round program may "
+            "run double precision",
+        ))
+    return findings
+
+
+def _check_structure(
+    name: str, prog_a: CanonicalProgram, prog_b: CanonicalProgram
+) -> List[Finding]:
+    """MUR203: same primitive tree at both canonical network sizes."""
+    path, line = _rule_anchor(name)
+    sig_a = jaxpr_signature(trace_jaxpr(prog_a))
+    sig_b = jaxpr_signature(trace_jaxpr(prog_b))
+    if sig_a == sig_b:
+        return []
+    # First structural divergence, for a legible message.
+    i = next(
+        (k for k, (x, y) in enumerate(zip(sig_a, sig_b)) if x != y),
+        min(len(sig_a), len(sig_b)),
+    )
+    at_a = sig_a[i] if i < len(sig_a) else "<end>"
+    at_b = sig_b[i] if i < len(sig_b) else "<end>"
+    return [Finding(
+        "MUR203", path, line,
+        f"aggregator '{name}' ({_mode(prog_a.circulant)}) traces to "
+        f"structurally different programs at n={prog_a.n} "
+        f"({len(sig_a)} eqns) vs n={prog_b.n} ({len(sig_b)} eqns); first "
+        f"divergence at eqn {i}: {at_a} vs {at_b} — the program must be "
+        "identical up to dimension constants or every network size "
+        "recompiles a different computation",
+    )]
+
+
+def _check_collectives(name: str, prog: CanonicalProgram) -> List[Finding]:
+    """MUR202: lowered collective inventory vs declared_collectives()."""
+    path, line = _rule_anchor(name)
+    declared = prog.agg.declared_collectives(prog.circulant)
+    if declared is None:
+        return [Finding(
+            "MUR202", path, line,
+            f"aggregator '{name}' declares no collective inventory — set "
+            "AggregatorDef.collectives (dense/circulant sets drawn from "
+            "aggregation.base.COLLECTIVE_NAMES) so stray communication "
+            "becomes a check failure instead of an ICI surprise",
+        )]
+    found = collective_inventory(prog)
+    if found is None:
+        warnings.warn(
+            "murmura check --ir: fewer than 2 devices available — the "
+            "MUR202 collective inventory is unobservable on this platform "
+            "(run under XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+            stacklevel=2,
+        )
+        return []
+    stray = found - declared
+    if not stray:
+        return []
+    return [Finding(
+        "MUR202", path, line,
+        f"aggregator '{name}' ({_mode(prog.circulant)}) lowers to "
+        f"undeclared collective(s) {sorted(stray)} (declared: "
+        f"{sorted(declared)}) — either the rule grew unintended "
+        "communication or its declared_collectives() contract is stale",
+    )]
+
+
+def check_donation() -> List[Finding]:
+    """MUR204: the round step's donated buffers are actually aliased.
+
+    Compiles two canonical tiny round programs (a stateless rule and one
+    with carried aggregation state) exactly as the simulation backend does
+    (jit + donate_argnums=(0, 1), core/network.py) and requires one
+    input/output alias per donated leaf in the optimized HLO.  A missing
+    alias means XLA rejected the donation — params or state silently cost
+    an extra full copy per round.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.base import FederatedArrays
+    from murmura_tpu.models import make_mlp
+
+    pkg = Path(__file__).resolve().parent.parent
+    anchor = str(pkg / "core" / "rounds.py")
+    findings: List[Finding] = []
+
+    n, s = 4, 16
+    rng = np.random.default_rng(0)
+    data = FederatedArrays(
+        x=rng.normal(size=(n, s, _PROBE_IN)).astype(np.float32),
+        y=rng.integers(0, _PROBE_CLASSES, size=(n, s)).astype(np.int32),
+        mask=np.ones((n, s), np.float32),
+        num_samples=np.full((n,), s),
+        num_classes=_PROBE_CLASSES,
+    )
+    model = make_mlp(
+        input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+    )
+
+    model_dim = _probe_model()[2]
+    for rule in ("fedavg", "sketchguard"):
+        agg = build_aggregator(
+            rule, dict(AGG_CASES[rule]), model_dim=model_dim, total_rounds=5
+        )
+        prog = build_round_program(
+            model, agg, data, total_rounds=5, batch_size=8
+        )
+        args = (
+            prog.init_params,
+            {k: jnp.asarray(v) for k, v in prog.init_agg_state.items()},
+            jax.random.PRNGKey(0),
+            jnp.asarray(_canonical_adj(n, circulant=False)),
+            jnp.zeros((n,), jnp.float32),
+            jnp.asarray(0.0, jnp.float32),
+            {k: jnp.asarray(v) for k, v in prog.data_arrays.items()},
+        )
+        donated = len(jax.tree_util.tree_leaves(args[0])) + len(
+            jax.tree_util.tree_leaves(args[1])
+        )
+        # Two one-shot analysis compiles, not a hot path — the per-iteration
+        # fresh jit cache is the point (each rule gets its own executable).
+        step = jax.jit(prog.train_step, donate_argnums=(0, 1))  # murmura: ignore[MUR004]
+        txt = step.lower(*args).compile().as_text()
+        aliased = len(_ALIAS_RE.findall(txt))
+        if aliased < donated:
+            findings.append(Finding(
+                "MUR204", anchor, 1,
+                f"round step with '{rule}': only {aliased} of {donated} "
+                "donated buffers (params + carried aggregation state) are "
+                "aliased in the compiled executable — the rest pay a full "
+                "extra copy per round despite donate_argnums=(0, 1)",
+            ))
+    return findings
+
+
+def check_coverage() -> List[Finding]:
+    """MUR205: registry <-> canonical-case bijection (the MUR101
+    counterpart that keeps every other MUR2xx rule non-vacuous)."""
+    from murmura_tpu.aggregation import AGGREGATORS
+
+    pkg = Path(__file__).resolve().parent.parent
+    agg_path = str(pkg / "aggregation" / "__init__.py")
+    here = str(Path(__file__).resolve())
+    findings: List[Finding] = []
+    for name in sorted(set(AGGREGATORS) - set(AGG_CASES)):
+        findings.append(Finding(
+            "MUR205", agg_path, 1,
+            f"aggregation rule '{name}' has no AGG_CASES entry "
+            "(analysis/ir.py) — the IR contracts (MUR200-203) and cost "
+            "budgets never run for it; add a canonical case",
+        ))
+    for name in sorted(set(AGG_CASES) - set(AGGREGATORS)):
+        findings.append(Finding(
+            "MUR205", here, 1,
+            f"AGG_CASES entry '{name}' names no registered aggregation "
+            "rule — remove the stale canonical case",
+        ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Entry point
+# --------------------------------------------------------------------------
+
+_IR_MEMO: Optional[List[Finding]] = None
+
+
+def _apply_suppressions(findings: List[Finding]) -> List[Finding]:
+    """Line suppressions at each finding's anchor (the factory def line)."""
+    out: List[Finding] = []
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    for path, fs in by_path.items():
+        try:
+            lines = Path(path).read_text().splitlines()
+        except OSError:
+            out.extend(fs)
+            continue
+        out.extend(_suppressed(fs, lines))
+    return out
+
+
+def check_ir(force: bool = False) -> List[Finding]:
+    """Run MUR200-205 over the canonical grid; returns findings (empty =
+    every IR contract holds).  Memoized per process — the tier-1 gate, the
+    CLI test and the battery pre-flight share one sweep.
+
+    Cost budgets (MUR206) live in :mod:`murmura_tpu.analysis.budgets` and
+    are composed by ``run_check``, not here — they need AOT compiles per
+    grid cell while everything here except MUR202/204 is trace-only.
+    """
+    global _IR_MEMO
+    if _IR_MEMO is not None and not force:
+        return list(_IR_MEMO)
+
+    _ensure_host_devices()
+    from murmura_tpu.aggregation import AGGREGATORS
+
+    findings: List[Finding] = list(check_coverage())
+    n_a, n_b = IR_NODE_COUNTS
+    for name in sorted(AGGREGATORS):
+        if name not in AGG_CASES:
+            continue  # already a MUR205 finding
+        for circulant in (False, True):
+            # A crash anywhere — building the canonical program, tracing,
+            # or the sharded lowering — IS the finding: one broken rule
+            # must not take down the whole check run and hide every other
+            # finding.
+            try:
+                prog = build_canonical(name, n_a, "float32", circulant)
+                prog_b = build_canonical(name, n_b, "float32", circulant)
+                prog_bf16 = build_canonical(name, n_a, "bfloat16", circulant)
+                sharded = build_canonical(
+                    name, n_a, "float32", circulant, node_axis_sharded=True
+                )
+                jaxpr = trace_jaxpr(prog)
+                findings.extend(_check_callbacks(name, prog, jaxpr))
+                findings.extend(_check_dtypes(name, prog, prog_bf16))
+                findings.extend(_check_structure(name, prog, prog_b))
+                findings.extend(_check_collectives(name, sharded))
+            except Exception as e:  # noqa: BLE001 — a crash IS the finding
+                path, line = _rule_anchor(name)
+                findings.append(Finding(
+                    "MUR205", path, line,
+                    f"aggregator '{name}' ({_mode(circulant)}) crashed the "
+                    f"canonical IR sweep: {type(e).__name__}: {e}",
+                ))
+    try:
+        findings.extend(check_donation())
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        pkg = Path(__file__).resolve().parent.parent
+        findings.append(Finding(
+            "MUR204", str(pkg / "core" / "rounds.py"), 1,
+            f"the donation audit crashed compiling the canonical round "
+            f"programs: {type(e).__name__}: {e}",
+        ))
+
+    findings = _apply_suppressions(list(dict.fromkeys(findings)))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _IR_MEMO = list(findings)
+    return findings

@@ -50,9 +50,9 @@ bare ``ignore`` for all rules) to the flagged line.
 
 import ast
 import re
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from pathlib import Path
-from typing import Dict, Iterator, List, Sequence, Set, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
 
 RULES: Dict[str, str] = {
     "MUR000": "syntax-error",
@@ -67,6 +67,15 @@ RULES: Dict[str, str] = {
     "MUR101": "registry-schema-sync",
     "MUR102": "per-rule-test-coverage",
     "MUR103": "topology-zero-diagonal",
+    # 2xx = jaxpr/HLO-level IR contracts (analysis/ir.py) and AOT cost
+    # budgets (analysis/budgets.py)
+    "MUR200": "ir-host-callback",
+    "MUR201": "ir-dtype-discipline",
+    "MUR202": "ir-collective-inventory",
+    "MUR203": "ir-shape-polymorphism",
+    "MUR204": "ir-donation",
+    "MUR205": "ir-coverage",
+    "MUR206": "cost-budget-drift",
 }
 
 
@@ -76,6 +85,9 @@ class Finding:
     path: str
     line: int
     message: str
+    # Optional machine-readable payload for `check --json` (budget deltas
+    # etc.).  Excluded from eq/hash so findings stay dedupable.
+    data: Optional[dict] = field(default=None, compare=False)
 
     @property
     def name(self) -> str:

@@ -175,19 +175,60 @@ def run_node(config_path: Path, node_id, t_start, run_id, host):
     help="Also run the cross-layer contract checks (registry/schema/test "
          "sync, topology zero-diagonal)",
 )
-def check(paths, contracts):
+@click.option(
+    "--ir/--no-ir", "ir", default=None,
+    help="Run the jaxpr/HLO IR contracts (MUR200-205) and AOT cost budgets "
+         "(MUR206).  Default: on for the package check, off when explicit "
+         "PATHS are given (the IR pass traces the live registry, not "
+         "files).",
+)
+@click.option(
+    "--json", "as_json", is_flag=True, default=False,
+    help="Emit findings (and budget deltas) as JSON lines for editor/CI "
+         "annotation instead of the greppable text format.",
+)
+@click.option(
+    "--update-budgets", is_flag=True, default=False,
+    help="Re-measure the AOT cost grid and rewrite analysis/BUDGETS.json; "
+         "review the diff as perf history.",
+)
+def check(paths, contracts, ir, as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
     Runs the AST lint rules (MUR001-006: traced branches, host syncs,
-    recompilation hazards, import-time allocation, dtype promotion) plus
-    the cross-layer contract checks (MUR101-103).  Exits non-zero when any
-    finding survives suppression.  See docs/ANALYSIS.md for the rule
-    catalogue and the ``# murmura: ignore[...]`` suppression syntax.
+    recompilation hazards, import-time allocation, dtype promotion), the
+    cross-layer contract checks (MUR101-103), and — for the package check —
+    the jaxpr/HLO IR contracts plus committed cost budgets (MUR200-206).
+    Exits non-zero when any finding survives suppression.  See
+    docs/ANALYSIS.md for the rule catalogue and the
+    ``# murmura: ignore[...]`` suppression syntax.
     """
-    from murmura_tpu.analysis import format_findings, run_check
+    if update_budgets:
+        from murmura_tpu.analysis import budgets
 
-    findings = run_check(list(paths) or None, contracts=contracts)
+        path = budgets.update_budgets()
+        console.print(
+            f"Budgets rewritten to [bold]{path}[/bold] — review the diff "
+            "as perf history"
+        )
+        return
+    from murmura_tpu.analysis import (
+        format_findings,
+        format_findings_json,
+        run_check_detailed,
+    )
+
+    findings, deltas = run_check_detailed(
+        list(paths) or None, contracts=contracts, ir=ir
+    )
+    if as_json:
+        out = format_findings_json(findings, deltas)
+        if out:
+            click.echo(out)
+        if findings:
+            raise SystemExit(1)
+        return
     if findings:
         click.echo(format_findings(findings))
         console.print(

@@ -189,10 +189,15 @@ class Network:
 
         Uses the AOT path on the same shapes ``train`` runs, so the compile
         cache is hit and nothing executes.  Basis for the bench's MFU
-        estimate (flops/round x rounds/sec / peak chip flops).  Covers the
+        estimate (flops/round x rounds/sec / peak chip flops) and the
+        runtime twin of the per-aggregator budget sweep
+        (``murmura check --ir``, analysis/budgets.py — which also owns the
+        cross-version result normalization used here).  Covers the
         per-round program only — eval is compiled separately and runs on the
         ``eval_every`` cadence, so its flops are not part of a round.
         """
+        from murmura_tpu.analysis.budgets import normalize_cost_analysis
+
         args = (
             self.params,
             self.agg_state,
@@ -202,10 +207,9 @@ class Network:
             jnp.asarray(0.0, dtype=jnp.float32),
             self._data,
         )
-        cost = self._step.lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):  # older jax returns [dict]
-            cost = cost[0] if cost else {}
-        return dict(cost or {})
+        return normalize_cost_analysis(
+            self._step.lower(*args).compile().cost_analysis()
+        )
 
     def train(
         self,

@@ -15,6 +15,16 @@ import numpy as np
 from jax.flatten_util import ravel_pytree
 
 
+def _leaf_spec(leaf):
+    """(shape, dtype) of a pytree leaf — arrays and eval_shape structs via
+    their attributes (no device transfer), raw Python scalars (which
+    ravel_pytree accepts) via numpy inference."""
+    if hasattr(leaf, "dtype") and hasattr(leaf, "shape"):
+        return tuple(leaf.shape), np.dtype(leaf.dtype)
+    arr = np.asarray(leaf)
+    return arr.shape, arr.dtype
+
+
 def make_flatteners(
     template: Any,
 ) -> Tuple[Callable[[Any], jnp.ndarray], Callable[[jnp.ndarray], Any], int]:
@@ -22,7 +32,27 @@ def make_flatteners(
 
     ``ravel`` and ``unravel`` are jit/vmap-compatible; vmap them to map
     stacked [N, ...] params to the [N, P] neighbor tensor and back.
+
+    Rejects non-float leaves loudly: the aggregation library operates on
+    float parameter vectors (models/core.py's LayerNorm-over-BatchNorm
+    design note exists precisely to keep model state all-float), and a
+    silently ravelled integer buffer would (a) be "aggregated" by means —
+    meaningless — and (b) disagree with :func:`model_dimension`'s
+    documented float-only count, desynchronizing every consumer that sizes
+    buffers from it (sketchguard's sketch tables).
     """
+    bad = []
+    for leaf in jax.tree_util.tree_leaves(template):
+        shape, dtype = _leaf_spec(leaf)
+        if not jnp.issubdtype(dtype, jnp.floating):
+            bad.append(f"{type(leaf).__name__}{shape}:{dtype}")
+    if bad:
+        raise TypeError(
+            "aggregation operates on float parameter vectors; the model "
+            f"template carries non-float leaves {bad} — keep trainable "
+            "state float (see models/core.py normalization note) or strip "
+            "non-float buffers before handing params to the round program"
+        )
     flat0, unravel = ravel_pytree(template)
 
     def ravel(tree: Any) -> jnp.ndarray:
@@ -35,7 +65,16 @@ def model_dimension(template: Any) -> int:
     """Total float parameter count (reference: aggregation/base.py:155-170).
 
     Works on concrete arrays and on ``jax.eval_shape`` ShapeDtypeStructs.
+    Counts only floating-dtype leaves, as documented: the reference's
+    ``calculate_model_dimension`` skips non-float state (BatchNorm's
+    integer ``num_batches_tracked`` buffers) because only float parameters
+    are aggregated.  The repo's own models are all-float by design
+    (models/core.py LayerNorm note), but externally supplied factories may
+    carry integer buffers — those must not inflate the sketch sizing /
+    model_dim plumbing that consumes this count.
     """
     return sum(
-        int(np.prod(leaf.shape)) for leaf in jax.tree_util.tree_leaves(template)
+        int(np.prod(_leaf_spec(leaf)[0]))
+        for leaf in jax.tree_util.tree_leaves(template)
+        if jnp.issubdtype(_leaf_spec(leaf)[1], jnp.floating)
     )

@@ -23,9 +23,12 @@ run() {
 # Pre-flight gate: the static analyzer (docs/ANALYSIS.md) must be clean
 # before any bench touches the chip — a traced-branch/host-sync/recompile
 # hazard in the round path invalidates every number the battery produces.
+# --ir adds the jaxpr/HLO contracts and the committed AOT cost budgets
+# (MUR200-206): an undeclared collective or a >10% FLOPs drift in any
+# aggregator aborts the battery before a single chip-second is spent.
 # CPU-pinned so the gate itself cannot wedge the single-tenant TPU.
-echo "=== preflight: murmura check ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
-if ! timeout 300 env JAX_PLATFORMS=cpu python -m murmura_tpu check murmura_tpu/ \
+echo "=== preflight: murmura check --ir ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+if ! timeout 600 env JAX_PLATFORMS=cpu python -m murmura_tpu check --ir murmura_tpu/ \
     > "$OUT/preflight_check.out" 2>&1; then
   echo "preflight murmura check FAILED — aborting battery" | tee -a "$OUT/battery.log"
   cat "$OUT/preflight_check.out" | tee -a "$OUT/battery.log"

@@ -23,7 +23,10 @@ class TestRepoIsClean:
     """The tier-1 CI gate: every future PR must keep the package clean."""
 
     def test_full_check_runs_clean(self):
-        findings = run_check([PKG])
+        # ir=True: the jaxpr/HLO contracts and cost budgets (MUR200-206)
+        # are part of the gate (ISSUE 2 acceptance) — explicit because
+        # passing paths would otherwise default the IR pass off.
+        findings = run_check([PKG], ir=True)
         assert findings == [], "\n".join(
             f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
         )

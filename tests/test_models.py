@@ -15,6 +15,43 @@ def _param_count(model):
     return model_dimension(jax.eval_shape(model.init, jax.random.PRNGKey(0)))
 
 
+def test_model_dimension_counts_only_float_leaves():
+    """ISSUE 2 satellite regression: model_dimension's documented contract
+    is the *float* parameter count (only float parameters are aggregated —
+    the reference skips BatchNorm's integer num_batches_tracked buffers),
+    so an integer leaf in an externally supplied pytree must not inflate
+    the sketch sizing / model_dim plumbing."""
+    tree = {
+        "w": np.zeros((4, 5), np.float32),          # 20
+        "b": jnp.zeros((5,), jnp.bfloat16),         # 5
+        "steps": np.zeros((3,), np.int32),          # int buffer: excluded
+        "flag": jnp.zeros((2, 2), jnp.bool_),       # bool buffer: excluded
+    }
+    assert model_dimension(tree) == 25
+    # eval_shape structs carry dtypes too — same filtering applies.
+    structs = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree
+    )
+    assert model_dimension(structs) == 25
+
+
+def test_make_flatteners_rejects_non_float_leaves():
+    """The counterpart contract: the [N, P] aggregation pipeline is
+    float-only, so a mixed tree must fail loudly at build time (where the
+    message can point at the design note) instead of desynchronizing
+    model_dimension consumers (sketch table sizing) from the ravelled
+    vector, or 'aggregating' integer buffers by means."""
+    from murmura_tpu.ops.flatten import make_flatteners
+
+    tree = {"w": np.zeros((4, 5), np.float32), "steps": np.zeros((3,), np.int32)}
+    with pytest.raises(TypeError, match="non-float leaves"):
+        make_flatteners(tree)
+    # Raw Python float leaves stay supported (ravel_pytree accepts them).
+    ravel, _, dim = make_flatteners({"w": np.zeros((2,), np.float32), "s": 1.0})
+    assert dim == 3
+    assert model_dimension({"w": np.zeros((2,), np.float32), "s": 1.0}) == 3
+
+
 def _forward(model, batch=3):
     params = model.init(jax.random.PRNGKey(0))
     x_shape = (batch,) + tuple(model.input_shape)

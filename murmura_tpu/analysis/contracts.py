@@ -212,4 +212,48 @@ def check_contracts(tests_dir: Optional[Path] = None) -> List[Finding]:
                 f"MobilityModel.adjacency_at({r}) emitted self-edges — "
                 "the dynamic G^t must keep the zero-diagonal invariant",
             ))
+
+    # -- MUR300/301: fault-masked adjacency stays a valid neighbor mask -----
+    # The fault schedule composes multiplicatively into every adjacency
+    # source (static topology, mobility G^t); it may only REMOVE edges and
+    # must re-assert the zero diagonal the aggregation rules lean on.
+    sched_path = str(pkg / "faults" / "schedule.py")
+    try:
+        from murmura_tpu.faults.schedule import FaultSchedule
+    except Exception as e:  # noqa: BLE001 — the import failure IS the finding
+        findings.append(Finding(
+            "MUR300", sched_path, 1,
+            f"faults.schedule failed to import ({type(e).__name__}: {e}) — "
+            "the fault-mask contracts cannot be checked",
+        ))
+        return findings
+    sched = FaultSchedule(
+        6, crash_prob=0.35, recovery_prob=0.3, link_drop_prob=0.3,
+        straggler_prob=0.3, seed=0,
+    )
+    sources = [("mobility G^t", np.asarray(mob.adjacency_at(3), np.float32))]
+    try:
+        sources.append(
+            ("ring topology",
+             generators.create_topology("ring", num_nodes=6).mask()),
+        )
+    except Exception:  # noqa: BLE001 — already a MUR103 finding above
+        pass
+    for label, adj in sources:
+        for r in (0, 2, 7):
+            masked = sched.masked_adjacency(adj, r)
+            if np.asarray(masked).diagonal().any():
+                findings.append(Finding(
+                    "MUR301", sched_path, 1,
+                    f"FaultSchedule.masked_adjacency over the {label} "
+                    f"emitted self-edges at round {r} — the fault-masked "
+                    "adjacency must keep the zero-diagonal invariant",
+                ))
+            if (np.asarray(masked) > np.asarray(adj, dtype=np.float32)).any():
+                findings.append(Finding(
+                    "MUR301", sched_path, 1,
+                    f"FaultSchedule.masked_adjacency over the {label} "
+                    f"ADDED edge weight at round {r} — fault masking may "
+                    "only remove edges, never create or amplify them",
+                ))
     return findings

@@ -616,6 +616,138 @@ def check_donation() -> List[Finding]:
     return findings
 
 
+def check_fault_round() -> List[Finding]:
+    """MUR302/MUR303: the fault model is IR-inert.
+
+    The faults subsystem's core promise (docs/ROBUSTNESS.md) is that churn
+    composes into the compiled round as *values*, not structure.  Two
+    machine-checked halves:
+
+    MUR302 — alive-mask variation causes no recompile: the faulted round
+    step compiles once and three rounds with three different alive masks
+    re-use that executable (CompileTracker, analysis/sanitizers.py).
+
+    MUR303 — faulted jaxprs stay collective-clean (the MUR202 companion):
+    sharding the faulted round over a node mesh must lower to exactly the
+    collective inventory of the unfaulted round — the sentinel's
+    isfinite/where/rollback plumbing is elementwise over node-local rows
+    and may not grow cross-device communication.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.analysis.sanitizers import RecompileError, track_compiles
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.base import FederatedArrays
+    from murmura_tpu.faults.schedule import FaultSpec
+    from murmura_tpu.models import make_mlp
+
+    pkg = Path(__file__).resolve().parent.parent
+    anchor = str(pkg / "core" / "rounds.py")
+    findings: List[Finding] = []
+
+    n, s = 4, 16
+    rng = np.random.default_rng(0)
+    data = FederatedArrays(
+        x=rng.normal(size=(n, s, _PROBE_IN)).astype(np.float32),
+        y=rng.integers(0, _PROBE_CLASSES, size=(n, s)).astype(np.int32),
+        mask=np.ones((n, s), np.float32),
+        num_samples=np.full((n,), s),
+        num_classes=_PROBE_CLASSES,
+    )
+    model = make_mlp(
+        input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+    )
+    agg = build_aggregator(
+        "fedavg", {}, model_dim=_probe_model()[2], total_rounds=5
+    )
+    base = build_round_program(model, agg, data, total_rounds=5, batch_size=8)
+    faulted = build_round_program(
+        model, agg, data, total_rounds=5, batch_size=8, faults=FaultSpec()
+    )
+    adj = jnp.asarray(_canonical_adj(n, circulant=False))
+    d = {k: jnp.asarray(v) for k, v in faulted.data_arrays.items()}
+
+    def args_for(prog, alive, r):
+        a = [
+            prog.init_params,
+            {k: jnp.asarray(v) for k, v in prog.init_agg_state.items()},
+            jax.random.PRNGKey(r),
+            adj,
+            jnp.zeros((n,), jnp.float32),
+            jnp.asarray(float(r), jnp.float32),
+            d,
+        ]
+        if prog.faulted:
+            a.insert(5, jnp.asarray(alive, jnp.float32))
+        return a
+
+    # -- MUR302 ------------------------------------------------------------
+    # One-shot analysis compile, not a hot path (the MUR204 pattern).
+    step = jax.jit(faulted.train_step)  # murmura: ignore[MUR004]
+    masks = [
+        np.ones(n, np.float32),
+        np.array([1, 0, 1, 1], np.float32),
+        np.array([0, 1, 0, 1], np.float32),
+    ]
+    try:
+        with track_compiles() as tracker:
+            tracker.begin("warmup")
+            jax.block_until_ready(step(*args_for(faulted, masks[0], 0))[0])
+            tracker.end(allow=True)
+            for r, alive in enumerate(masks[1:], start=1):
+                tracker.begin(f"round {r}")
+                jax.block_until_ready(step(*args_for(faulted, alive, r))[0])
+                tracker.end(allow=False)
+    except RecompileError as e:
+        findings.append(Finding(
+            "MUR302", anchor, 1,
+            f"varying the alive mask recompiled the faulted round step "
+            f"({e}) — churn must reach the compiled program as input "
+            "values, never as structure",
+        ))
+
+    # -- MUR303 ------------------------------------------------------------
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from murmura_tpu.parallel.mesh import _shard_round_fn
+
+    devices = jax.devices()
+    usable = [c for c in (2, 4) if c <= len(devices) and n % c == 0]
+    if not usable:
+        warnings.warn(
+            "murmura check --ir: fewer than 2 devices available — the "
+            "MUR303 faulted collective inventory is unobservable on this "
+            "platform (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+            stacklevel=2,
+        )
+        return findings
+    mesh = Mesh(np.array(devices[: max(usable)]), ("nodes",))
+    node_s = NamedSharding(mesh, P("nodes"))
+
+    def inventory(prog):
+        sharded = _shard_round_fn(
+            prog.train_step, prog, mesh, node_s, donate=False,
+            alive_sharding=node_s,
+        )
+        txt = sharded.lower(*args_for(prog, masks[1], 1)).compile().as_text()
+        return frozenset(_HLO_COLLECTIVES[m] for m in _COLL_RE.findall(txt))
+
+    stray = inventory(faulted) - inventory(base)
+    if stray:
+        findings.append(Finding(
+            "MUR303", anchor, 1,
+            f"the faulted round step lowers to collective(s) "
+            f"{sorted(stray)} absent from the unfaulted round — the fault "
+            "plumbing (alive freeze, NaN sentinel, rollback) must stay "
+            "node-local and communication-free",
+        ))
+    return findings
+
+
 def check_coverage() -> List[Finding]:
     """MUR205: registry <-> canonical-case bijection (the MUR101
     counterpart that keeps every other MUR2xx rule non-vacuous)."""
@@ -717,6 +849,15 @@ def check_ir(force: bool = False) -> List[Finding]:
             "MUR204", str(pkg / "core" / "rounds.py"), 1,
             f"the donation audit crashed compiling the canonical round "
             f"programs: {type(e).__name__}: {e}",
+        ))
+    try:
+        findings.extend(check_fault_round())
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        pkg = Path(__file__).resolve().parent.parent
+        findings.append(Finding(
+            "MUR302", str(pkg / "core" / "rounds.py"), 1,
+            f"the fault-model IR contracts crashed: "
+            f"{type(e).__name__}: {e}",
         ))
 
     findings = _apply_suppressions(list(dict.fromkeys(findings)))

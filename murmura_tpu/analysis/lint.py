@@ -76,6 +76,11 @@ RULES: Dict[str, str] = {
     "MUR204": "ir-donation",
     "MUR205": "ir-coverage",
     "MUR206": "cost-budget-drift",
+    # 3xx = fault-model contracts (analysis/contracts.py + analysis/ir.py)
+    "MUR300": "fault-import-failure",
+    "MUR301": "fault-mask-zero-diagonal",
+    "MUR302": "fault-mask-recompile",
+    "MUR303": "fault-collective-inventory",
 }
 
 

@@ -152,7 +152,10 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
 @click.option("--t-start", type=float, required=True, help="Shared round-0 start time")
 @click.option("--run-id", type=str, required=True, help="Run id from the head node")
 @click.option("--host", type=str, default=None, help="This node's bind host")
-def run_node(config_path: Path, node_id, t_start, run_id, host):
+@click.option("--resume/--no-resume", default=False,
+              help="Rejoin a running experiment from this node's last "
+                   "per-node checkpoint (faults.enabled crash recovery)")
+def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
     """Multi-machine ZMQ worker (reference: cli.py:143-208)."""
     from murmura_tpu.distributed.node_process import run_single_node
     from murmura_tpu.utils.factories import ConfigError
@@ -160,7 +163,8 @@ def run_node(config_path: Path, node_id, t_start, run_id, host):
     config = _load_config_or_die(config_path)
     try:
         run_single_node(
-            config, node_id=node_id, t_start=t_start, run_id=run_id, host=host
+            config, node_id=node_id, t_start=t_start, run_id=run_id, host=host,
+            resume=resume,
         )
     except ConfigError as e:
         _die_config_error(e)

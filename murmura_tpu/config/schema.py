@@ -7,7 +7,7 @@ strategy.  ``extra = "forbid"`` everywhere, like the reference
 (murmura/config/schema.py:200-202).
 """
 
-from typing import Any, Dict, Literal, Optional
+from typing import Any, Dict, List, Literal, Optional
 
 from pydantic import BaseModel, ConfigDict, Field, model_validator
 
@@ -106,6 +106,80 @@ class DMTTConfig(_Strict):
             "fallback (murmura_tpu extension; the reference accepts it "
             "silently — murmura/dmtt/node_process.py:247)"
         ),
+    )
+
+
+class FaultsConfig(_Strict):
+    """Operational fault model: churn, link drops, stragglers, NaN
+    quarantine (murmura_tpu extension; no reference counterpart — the
+    reference's only degradation path is the ZMQ deadline).
+
+    Default off => byte-identical behavior to a config without this block:
+    the compiled round program, history arrays, and random streams are
+    untouched unless ``enabled`` is true.  See docs/ROBUSTNESS.md.
+    """
+
+    enabled: bool = Field(default=False, description="Enable the fault model")
+    seed: int = Field(
+        default=777,
+        description=(
+            "Fault-schedule seed; every process reconstructs the identical "
+            "schedule from it (crash/recovery churn, link drops, stragglers)"
+        ),
+    )
+    crash_prob: float = Field(
+        default=0.0, ge=0.0, le=1.0,
+        description="Per-round P(alive node crashes)",
+    )
+    recovery_prob: float = Field(
+        default=0.0, ge=0.0, le=1.0,
+        description=(
+            "Per-round P(crashed node recovers), after min_down_rounds"
+        ),
+    )
+    min_down_rounds: int = Field(
+        default=1, ge=1,
+        description="Minimum rounds a crashed node stays down",
+    )
+    link_drop_prob: float = Field(
+        default=0.0, ge=0.0, le=1.0,
+        description="Per-round per-undirected-edge drop probability",
+    )
+    straggler_prob: float = Field(
+        default=0.0, ge=0.0, le=1.0,
+        description=(
+            "Per-round P(node straggles): its update misses the delivery "
+            "deadline (jitted backends: outgoing contributions masked; "
+            "distributed: the node actually sleeps)"
+        ),
+    )
+    straggler_factor: float = Field(
+        default=2.0, ge=1.0,
+        description=(
+            "Training-time multiplier a straggle simulates on the "
+            "distributed backend (sleep of (factor-1) x training time, "
+            "capped at the round window)"
+        ),
+    )
+    nan_quarantine: bool = Field(
+        default=True,
+        description=(
+            "In-jit numerical sentinel: after local training, nodes whose "
+            "flattened update contains non-finite values are quarantined "
+            "for the round — masked out of the exchange, params rolled "
+            "back to the pre-round value — instead of poisoning the fleet"
+        ),
+    )
+    nan_inject_nodes: List[int] = Field(
+        default_factory=list,
+        description=(
+            "Deterministic divergence injection for chaos testing: these "
+            "nodes emit NaN updates from nan_inject_from_round on"
+        ),
+    )
+    nan_inject_from_round: int = Field(
+        default=0, ge=0,
+        description="First round nan_inject_nodes emit NaNs",
     )
 
 
@@ -300,6 +374,27 @@ class Config(_Strict):
         default=None,
         description="DMTT protocol settings; requires mobility to also be set",
     )
+    faults: FaultsConfig = Field(
+        default_factory=FaultsConfig,
+        description=(
+            "Operational fault model (churn/link drops/stragglers/NaN "
+            "quarantine); default off => byte-identical to no faults block"
+        ),
+    )
+
+    @model_validator(mode="after")
+    def _faults_injection_in_range(self):
+        if self.faults.enabled and self.faults.nan_inject_nodes:
+            bad = [
+                i for i in self.faults.nan_inject_nodes
+                if not 0 <= i < self.topology.num_nodes
+            ]
+            if bad:
+                raise ValueError(
+                    f"faults.nan_inject_nodes {bad} out of range for "
+                    f"topology.num_nodes={self.topology.num_nodes}"
+                )
+        return self
 
     @model_validator(mode="after")
     def _dmtt_requires_mobility(self):

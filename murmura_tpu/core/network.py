@@ -37,6 +37,7 @@ class Network:
         profile_dir: Optional[str] = None,
         recompile_guard: bool = False,
         transfer_guard: bool = False,
+        fault_schedule=None,
     ):
         self.program = program
         self.topology = topology
@@ -45,6 +46,17 @@ class Network:
         self.backend = backend
         self.seed = seed
         self.profile_dir = profile_dir
+        # Operational fault model (faults/schedule.py): per-round alive and
+        # link masks fold into the adjacency input and the faulted
+        # program's alive argument — values only, no recompiles (the same
+        # trick the compromised mask and mobility G^t already use).
+        self.fault_schedule = fault_schedule
+        if fault_schedule is not None and not program.faulted:
+            raise ValueError(
+                "A fault schedule was supplied but the round program was "
+                "built without faults (build_round_program(faults=...)); "
+                "the alive mask would silently never reach the round step"
+            )
         # Opt-in runtime sanitizers (tpu.recompile_guard / tpu.transfer_guard;
         # analysis/sanitizers.py).  Backend-independent: the simulation
         # backend exercises them in CI where no chip is at stake.
@@ -181,8 +193,21 @@ class Network:
 
     def _adjacency_for_round(self, round_idx: int) -> np.ndarray:
         if self.mobility is not None:
-            return self.mobility.adjacency_at(round_idx).astype(np.float32)
-        return self.topology.mask()
+            adj = self.mobility.adjacency_at(round_idx).astype(np.float32)
+        else:
+            adj = self.topology.mask()
+        if self.fault_schedule is not None:
+            # adj * alive_i * alive_j * link_mask * straggler columns —
+            # folded host-side so the compiled program only ever sees a
+            # differently-valued adjacency input.
+            adj = self.fault_schedule.masked_adjacency(adj, round_idx)
+        return adj
+
+    def _alive_for_round(self, round_idx: int) -> np.ndarray:
+        """[N] float32 alive mask for a faulted program's extra input."""
+        if self.fault_schedule is not None:
+            return self.fault_schedule.alive_at(round_idx)
+        return np.ones(self.program.num_nodes, dtype=np.float32)
 
     def step_cost_analysis(self) -> Dict[str, float]:
         """XLA cost analysis of the compiled train step (flops, bytes).
@@ -198,7 +223,7 @@ class Network:
         """
         from murmura_tpu.analysis.budgets import normalize_cost_analysis
 
-        args = (
+        args = [
             self.params,
             self.agg_state,
             jax.random.PRNGKey(0),
@@ -206,7 +231,9 @@ class Network:
             jnp.asarray(self.compromised),
             jnp.asarray(0.0, dtype=jnp.float32),
             self._data,
-        )
+        ]
+        if self.program.faulted:
+            args.insert(5, jnp.asarray(self._alive_for_round(self.current_round)))
         return normalize_cost_analysis(
             self._step.lower(*args).compile().cost_analysis()
         )
@@ -343,7 +370,7 @@ class Network:
                 ),
                 self._adj_stack_s,
             )
-            self.params, self.agg_state, rows = step(
+            step_args = [
                 self.params,
                 self.agg_state,
                 self._stage(self._rng, self._repl),
@@ -351,7 +378,19 @@ class Network:
                 comp,
                 self._stage(np.asarray(round0, np.int32), self._repl),
                 self._data,
-            )
+            ]
+            if self.program.faulted:
+                # Per-round alive masks ride the scan like the adj stack.
+                step_args.insert(
+                    5,
+                    self._stage(
+                        np.stack(
+                            [self._alive_for_round(round0 + i) for i in range(k)]
+                        ),
+                        self._adj_stack_s,
+                    ),
+                )
+            self.params, self.agg_state, rows = step(*step_args)
             rows = jax.device_get(rows)
             chunk_warmup = program_key not in self._warmed
             self._warmed.add(program_key)
@@ -409,7 +448,7 @@ class Network:
                 ),
                 self._repl,
             )
-            self.params, self.agg_state, agg_metrics = self._step(
+            step_args = [
                 self.params,
                 self.agg_state,
                 step_key,
@@ -417,7 +456,12 @@ class Network:
                 comp,
                 self._stage(np.asarray(round_idx, np.float32), self._repl),
                 self._data,
-            )
+            ]
+            if self.program.faulted:
+                step_args.insert(
+                    5, self._stage(self._alive_for_round(round_idx), self._node_s)
+                )
+            self.params, self.agg_state, agg_metrics = self._step(*step_args)
             self._warmed.add("step")
             self.current_round = round_idx + 1
             if self.current_round % eval_every == 0:

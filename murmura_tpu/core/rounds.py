@@ -37,6 +37,7 @@ from murmura_tpu.aggregation.base import AggContext, AggregatorDef
 from murmura_tpu.aggregation.probe import combined_probe_metric, pairwise_probe_eval
 from murmura_tpu.attacks.base import Attack
 from murmura_tpu.data.base import FederatedArrays
+from murmura_tpu.faults.schedule import FaultSpec
 from murmura_tpu.dmtt.protocol import (
     DMTTParams,
     dmtt_round_update,
@@ -79,6 +80,11 @@ class RoundProgram:
     num_nodes: int
     model_dim: int
     evidential: bool
+    # Built with a FaultSpec: train_step takes an extra [N] ``alive`` mask
+    # after ``compromised`` (dead nodes freeze via the update mask, NaN
+    # sentinel quarantines non-finite updates).  False => the signature and
+    # traced program are byte-identical to pre-faults builds.
+    faulted: bool = False
 
 
 def _broadcast_to_leaf(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
@@ -103,6 +109,7 @@ def build_round_program(
     dmtt: Optional[DMTTParams] = None,
     param_dtype: Optional[str] = None,
     node_axis_sharded: bool = False,
+    faults: Optional[FaultSpec] = None,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -116,6 +123,15 @@ def build_round_program(
             TOPO_CLAIM verification, Beta trust, TopB collaborator selection
             gate the exchange mask handed to the aggregator
             (murmura/dmtt/node_process.py:150-250).
+        faults: when set, the round step takes an extra per-round ``alive``
+            mask (after ``compromised``) and gains the operational-fault
+            semantics (docs/ROBUSTNESS.md): dead nodes freeze params via
+            the update mask exactly like compromised ones; an in-jit
+            numerical sentinel quarantines nodes whose post-training
+            update is non-finite (masked out of the exchange, params
+            rolled back to the pre-round value); a node with zero alive
+            neighbors degrades to self-model.  ``None`` (default) leaves
+            the traced program byte-identical to pre-faults builds.
     """
     n = data.num_nodes
     num_classes = data.num_classes or model.num_classes
@@ -290,7 +306,13 @@ def build_round_program(
     attack_apply = attack.apply if attack is not None else None
     claims_fn = attack.claims_fn if attack is not None else None
 
-    def train_round(params, agg_state, key, adj, compromised, round_idx, d):  # murmura: traced
+    if faults is not None and faults.nan_inject_nodes:
+        _inject_rows = np.zeros(n, dtype=np.float32)
+        _inject_rows[list(faults.nan_inject_nodes)] = 1.0
+    else:
+        _inject_rows = None
+
+    def _round_body(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
         train_key, attack_key = jax.random.split(key)
         honest = 1.0 - compromised
 
@@ -301,16 +323,70 @@ def build_round_program(
             train_mask = jnp.ones_like(honest)
         else:
             train_mask = honest
+        if alive is not None:
+            # Dead nodes freeze via the update mask, exactly like
+            # compromised ones; pre-round snapshot for quarantine rollback
+            # and the dead-node param freeze below.  The adjacency is
+            # re-masked by alive IN-JIT even though the orchestrator's
+            # masked_adjacency already folds it host-side (idempotent:
+            # alive*alive == alive) — the program must not depend on a
+            # two-sources-of-truth contract between its adj and alive
+            # inputs to keep dead nodes out of the exchange.
+            adj = adj * alive[:, None] * alive[None, :]
+            train_mask = train_mask * alive
+            pre_flat = jax.vmap(ravel)(params)
         params = local_training(params, d, train_mask, train_key, round_idx)
 
         # 2. snapshot + attack on outgoing states (network.py:105-119)
         own_flat = jax.vmap(ravel)(params)
+        fault_stats = {}
+        if _inject_rows is not None:
+            # Deterministic divergence injection (chaos testing): scheduled
+            # nodes emit a NaN update from the configured round on.
+            inject = _inject_rows * (
+                round_idx >= faults.nan_inject_from_round
+            ).astype(jnp.float32)
+            own_flat = jnp.where(
+                inject[:, None] > 0, jnp.full_like(own_flat, jnp.nan), own_flat
+            )
+        if faults is not None and faults.nan_quarantine:
+            # Numerical sentinel: a non-finite update quarantines the node
+            # for the round.  Its row is REPLACED (not just masked) before
+            # any rule math — masked aggregation alone cannot contain a
+            # NaN row because 0 * nan == nan in every Gram/matmul path —
+            # and its exchange edges are zeroed both ways.
+            finite = jnp.isfinite(own_flat).all(axis=1)
+            alive_f = alive if alive is not None else jnp.ones_like(compromised)
+            fault_stats["quarantined"] = (
+                (1.0 - finite.astype(jnp.float32)) * alive_f
+            ).sum()
+            own_flat = jnp.where(finite[:, None], own_flat, pre_flat)
+            fin = finite.astype(adj.dtype)
+            adj = adj * fin[:, None] * fin[None, :]
+        else:
+            finite = None
         if attack_apply is not None:
             # Cast back: float32 attack noise must not promote the exchanged
             # [N, P] tensor when params are stored bfloat16 (tpu.param_dtype).
             bcast = attack_apply(
                 own_flat, compromised, attack_key, round_idx
             ).astype(own_flat.dtype)
+            if finite is not None:
+                # Second sentinel stage: the pre-training check cannot see
+                # an ATTACK that overflows to inf/NaN (huge noise_std,
+                # crafted states).  Mask such broadcast rows out of
+                # everyone's exchange and replace them with the sender's
+                # (already-scrubbed) own state so no rule math sees a
+                # non-finite row.  No rollback: the sender's own params
+                # are untouched by its broadcast.  Counted separately from
+                # `quarantined` (which implies a rollback) so the
+                # containment is visible in history, not silent.
+                bfin = jnp.isfinite(bcast).all(axis=1)
+                bcast = jnp.where(bfin[:, None], bcast, own_flat)
+                adj = adj * bfin[None, :].astype(adj.dtype)
+                fault_stats["attack_scrubbed"] = (
+                    1.0 - bfin.astype(jnp.float32)
+                ).sum()
         else:
             bcast = own_flat
 
@@ -357,11 +433,38 @@ def build_round_program(
             own_flat, bcast, adj, round_idx, rule_state, step_ctx
         )
         agg_state = {**agg_state, **rule_state}
+
+        if alive is not None:
+            # Zero alive neighbors (everyone crashed/dropped/straggled)
+            # degrades to self-model — some rules divide by degree and
+            # jnp.where cleanly discards whatever they produced there.
+            deg = adj.sum(axis=1)
+            new_flat = jnp.where((deg > 0)[:, None], new_flat, own_flat)
+            # Dead nodes' params freeze at the pre-round value (their
+            # process is gone; nothing may advance) and quarantined nodes
+            # roll back their divergent local step.
+            keep = alive > 0
+            if finite is not None:
+                keep = keep & finite
+            new_flat = jnp.where(keep[:, None], new_flat, pre_flat)
+            fault_stats["alive"] = alive.sum()
         params = jax.vmap(unravel)(new_flat)
 
         metrics = {f"agg_{k}": v for k, v in agg_stats.items()}
         metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
+        metrics.update({f"agg_{k}": v for k, v in fault_stats.items()})
         return params, agg_state, metrics
+
+    if faults is None:
+        def train_round(params, agg_state, key, adj, compromised, round_idx, d):  # murmura: traced
+            return _round_body(
+                params, agg_state, key, adj, compromised, None, round_idx, d
+            )
+    else:
+        def train_round(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
+            return _round_body(
+                params, agg_state, key, adj, compromised, alive, round_idx, d
+            )
 
     def eval_step(params, d):  # murmura: traced
         # evaluation (network.py:141-199) — held-out arrays when the data
@@ -385,6 +488,7 @@ def build_round_program(
         num_nodes=n,
         model_dim=model_dim,
         evidential=evidential,
+        faulted=faults is not None,
     )
 
 
@@ -407,6 +511,10 @@ def build_multi_round(program: RoundProgram, chunk: int, eval_every: int):
     the per-round adjacency (host-computed G^t for mobility; the static mask
     tiled otherwise); per-round RNG is ``fold_in(base_key, round)`` so a
     fused run consumes the same independent streams regardless of chunking.
+
+    Faulted programs (``program.faulted``) additionally take a per-round
+    ``alive_stack`` [chunk, N] after ``compromised`` — the fault-schedule
+    twin of ``adj_stack``, riding the same scan xs.
     """
     as_struct = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)  # noqa: E731
     eval_struct = jax.eval_shape(
@@ -415,31 +523,52 @@ def build_multi_round(program: RoundProgram, chunk: int, eval_every: int):
         {k: as_struct(v) for k, v in program.data_arrays.items()},
     )
 
-    def multi_round(params, agg_state, base_key, adj_stack, compromised, round0, data):  # murmura: traced
-        def body(carry, xs):
-            params, agg_state = carry
-            i, adj = xs
-            r = round0 + i
-            key = jax.random.fold_in(base_key, r)
-            params, agg_state, m = program.train_step(
-                params, agg_state, key, adj, compromised,
-                r.astype(jnp.float32), data,
-            )
-            do_eval = (r + 1) % eval_every == 0
-            ev = jax.lax.cond(
-                do_eval,
-                lambda p: program.eval_step(p, data),
-                lambda p: jax.tree_util.tree_map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), eval_struct
-                ),
-                params,
-            )
-            rows = {**m, **ev, "evaluated": do_eval}
-            return (params, agg_state), rows
-
-        (params, agg_state), rows = jax.lax.scan(
-            body, (params, agg_state), (jnp.arange(chunk), adj_stack)
+    def _body(carry, i, adj, alive, compromised, base_key, round0, data):
+        params, agg_state = carry
+        r = round0 + i
+        key = jax.random.fold_in(base_key, r)
+        step_args = [params, agg_state, key, adj, compromised]
+        if alive is not None:
+            step_args.append(alive)
+        params, agg_state, m = program.train_step(
+            *step_args, r.astype(jnp.float32), data,
         )
-        return params, agg_state, rows
+        do_eval = (r + 1) % eval_every == 0
+        ev = jax.lax.cond(
+            do_eval,
+            lambda p: program.eval_step(p, data),
+            lambda p: jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), eval_struct
+            ),
+            params,
+        )
+        rows = {**m, **ev, "evaluated": do_eval}
+        return (params, agg_state), rows
+
+    if program.faulted:
+        def multi_round(params, agg_state, base_key, adj_stack, compromised, alive_stack, round0, data):  # murmura: traced
+            def body(carry, xs):
+                i, adj, alive = xs
+                return _body(
+                    carry, i, adj, alive, compromised, base_key, round0, data
+                )
+
+            (params, agg_state), rows = jax.lax.scan(
+                body, (params, agg_state),
+                (jnp.arange(chunk), adj_stack, alive_stack),
+            )
+            return params, agg_state, rows
+    else:
+        def multi_round(params, agg_state, base_key, adj_stack, compromised, round0, data):  # murmura: traced
+            def body(carry, xs):
+                i, adj = xs
+                return _body(
+                    carry, i, adj, None, compromised, base_key, round0, data
+                )
+
+            (params, agg_state), rows = jax.lax.scan(
+                body, (params, agg_state), (jnp.arange(chunk), adj_stack)
+            )
+            return params, agg_state, rows
 
     return multi_round

@@ -29,6 +29,15 @@ class Endpoints:
         if self.cfg.transport == "ipc":
             os.makedirs(os.path.join(self.cfg.ipc_dir, self.run_id), exist_ok=True)
 
+    def node_checkpoint_path(self, node_id: int) -> str:
+        """Per-node crash-recovery checkpoint (faults.enabled runs).
+
+        Lives under the run's ipc_dir regardless of transport — it is a
+        LOCAL path on whichever machine hosts the node, which is exactly
+        the durability a restarted process on the same machine needs.
+        """
+        return self._ipc_path(f"node_{node_id}.ckpt.npz")
+
     # -- addresses ----------------------------------------------------------
 
     def node_bind(self, node_id: int, host: Optional[str] = None) -> str:

@@ -57,6 +57,7 @@ class NodeProcess:
         t_start: float,
         compromised_ids: List[int],
         host: Optional[str] = None,
+        resume: bool = False,
     ):
         self.config = config
         self.node_id = node_id
@@ -65,6 +66,11 @@ class NodeProcess:
         self.compromised_ids = set(compromised_ids)
         self.host = host
         self.is_compromised = node_id in self.compromised_ids
+        # Crash recovery (faults.enabled): a respawned process restores its
+        # last per-node checkpoint and rejoins at the wall-clock-current
+        # round instead of replaying from round 0.
+        self.resume = resume
+        self.start_round = 0
 
         self.endpoints = Endpoints(config.distributed, run_id)
         self.rounds = config.experiment.rounds
@@ -73,6 +79,7 @@ class NodeProcess:
         self.node = None
         self.attack = None
         self.mobility = None
+        self.fault_schedule = None
         self.static_neighbors: List[int] = []
         self._ctx = None
         self._pull = None
@@ -91,6 +98,15 @@ class NodeProcess:
         # per-node seeding (node_process.py:113)
         set_seed(self.config.experiment.seed + self.node_id)
         self._build_node()
+        if self.resume:
+            self._restore_node_checkpoint()
+            # Rejoin at the wall-clock-current round: round k occupies
+            # [t_start + k*dur, t_start + (k+1)*dur).  Scheduled-dead
+            # rounds between boot and recovery are self-skipped below.
+            self.start_round = max(
+                0,
+                int((time.monotonic() - self.t_start) // self.round_duration),
+            )
         self._setup_sockets()
         try:
             self._run_all_rounds()
@@ -108,11 +124,16 @@ class NodeProcess:
         from murmura_tpu.topology.generators import create_topology
         from murmura_tpu.utils.factories import (
             build_attack,
+            build_fault_schedule,
             build_mobility,
             resolve_model,
         )
 
         cfg = self.config
+        # Same deterministic schedule every process reconstructs from the
+        # seed — dead peers are excluded from expected-neighbor sets
+        # without any control messages (faults/schedule.py).
+        self.fault_schedule = build_fault_schedule(cfg)
         data = build_federated_data(
             cfg.data.adapter,
             cfg.data.params,
@@ -219,13 +240,31 @@ class NodeProcess:
             return self.mobility.neighbors_at(round_idx)[self.node_id]
         return list(self.static_neighbors)
 
+    def _scheduled_dead(self, round_idx: int) -> bool:
+        return (
+            self.fault_schedule is not None
+            and self.fault_schedule.alive_at(round_idx)[self.node_id] <= 0
+        )
+
     def _run_all_rounds(self) -> None:
-        for k in range(self.rounds):
+        for k in range(self.start_round, self.rounds):
             target = self.t_start + k * self.round_duration
             delay = target - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
+            if self._scheduled_dead(k):
+                # Self-enforced crash window: a dead process neither
+                # trains nor reports (reporting_nodes drops — the
+                # monitor's degradation telemetry).  Self-enforcement
+                # keeps multi-machine runs (no FaultInjector parent)
+                # honoring the schedule, and gives a respawned process a
+                # boot round before its scheduled recovery.  With the
+                # injector armed this is belt-and-suspenders: the process
+                # is normally SIGKILLed before it gets here.
+                continue
             self._execute_round(k)
+            if self.fault_schedule is not None:
+                self._save_node_checkpoint(k)
 
     @property
     def _is_colluder(self) -> bool:
@@ -240,14 +279,72 @@ class NodeProcess:
     def _execute_round(self, round_idx: int) -> None:
         """One wall-clock round (reference: node_process.py:193-247)."""
         deadline = self.t_start + (round_idx + 1) * self.round_duration
+        # 0. already past this round's deadline (a previous round's
+        # training overran the whole window, or a recovery boot landed
+        # late): publish the SKIPPED frame so the monitor stays
+        # index-aligned, instead of training into the next window and
+        # silently advancing.
+        if time.monotonic() >= deadline:
+            print(
+                f"[node {self.node_id}] round {round_idx}: round window "
+                "already elapsed; skipping",
+                flush=True,
+            )
+            self._send_metrics(round_idx, skipped=True)
+            return
         neighbors = self.current_neighbors(round_idx)
+        if self.fault_schedule is not None:
+            # Re-resolve the expected-neighbor set from the schedule:
+            # no waiting out the full deadline on a known-dead peer or a
+            # dropped link.  Symmetric link masks keep sender and receiver
+            # expectations consistent without communication.
+            alive = self.fault_schedule.alive_at(round_idx)
+            link = self.fault_schedule.link_mask_at(round_idx)
+            neighbors = [
+                j for j in neighbors
+                if alive[j] > 0 and link[self.node_id, j] > 0
+            ]
 
         # 1. local training (honest only — node_process.py:205-207).
         # ALIE/IPM colluders ALSO train: their benign states are the
         # coalition sample the papers' estimators run on (alie.py module
         # docstring); the benign result never leaves the coalition.
+        faults = self.config.faults if self.config.faults.enabled else None
+        pre_flat = None
+        if faults is not None and faults.nan_quarantine:
+            # Pre-round snapshot: a divergent (non-finite) local step rolls
+            # back to this instead of poisoning the exchange — the ZMQ twin
+            # of the in-jit sentinel (core/rounds.py, docs/ROBUSTNESS.md).
+            pre_flat = self.node.get_flat_state()
+        t_train0 = time.monotonic()
         if not self.is_compromised or self._is_colluder:
             self.node.local_train(round_idx)
+
+        # 1b. straggler realization: the schedule's boolean becomes an
+        # actual delay — (factor-1) x the measured training time, capped
+        # just past the round window.  Deliberately WEAKER than the jitted
+        # backends' model (which drops a straggler's outgoing column
+        # unconditionally): here the delay is physical, so whether the
+        # update misses the delivery deadline depends on real timing —
+        # a 2x slowdown that still fits the window delivers on time, as
+        # it would in production (docs/ROBUSTNESS.md).
+        if (
+            self.fault_schedule is not None
+            and self.fault_schedule.straggler_at(round_idx)[self.node_id]
+        ):
+            train_time = time.monotonic() - t_train0
+            delay = min(
+                (self.fault_schedule.straggler_factor - 1.0) * train_time,
+                max(0.0, deadline - time.monotonic()) + 0.5,
+            )
+            if delay > 0:
+                print(
+                    f"[node {self.node_id}] round {round_idx}: straggling "
+                    f"{delay:.2f}s (factor "
+                    f"{self.fault_schedule.straggler_factor})",
+                    flush=True,
+                )
+                time.sleep(delay)
 
         # 2. overrun check: skip exchange if training blew the window
         # (node_process.py:210-218)
@@ -260,11 +357,34 @@ class NodeProcess:
             self._send_metrics(round_idx, skipped=True)
             return
 
+        # 2b. numerical sentinel (faults.nan_quarantine): a non-finite
+        # post-training state quarantines this node for the round — params
+        # roll back to the pre-round snapshot and the exchange is skipped
+        # (neighbors degrade via the normal deadline semantics; they ALSO
+        # drop non-finite arrivals in _collect_states as defense in depth).
+        flat = self.node.get_flat_state()
+        if (
+            faults is not None
+            and self.node_id in faults.nan_inject_nodes
+            and round_idx >= faults.nan_inject_from_round
+        ):
+            # Deterministic divergence injection for chaos testing, same
+            # semantics as the jitted backends' nan_inject_nodes.
+            flat = np.full_like(flat, np.nan)
+        if pre_flat is not None and not np.isfinite(flat).all():
+            print(
+                f"[node {self.node_id}] round {round_idx}: non-finite local "
+                "update quarantined; rolling back to the pre-round state",
+                flush=True,
+            )
+            self.node.set_flat_state(pre_flat)
+            self._send_metrics(round_idx, skipped=False)
+            return
+
         # 3. attack own outgoing state (node_process.py:221-225).
         # ALIE/IPM colluders first exchange benign states within the
         # coalition; neighbor MODEL_STATEs arriving during that window are
         # buffered and handed to the collection in step 5.
-        flat = self.node.get_flat_state()
         prebuffered: Dict[int, np.ndarray] = {}
         if self._is_colluder:
             out_flat, prebuffered = self._colluding_state(
@@ -276,13 +396,9 @@ class NodeProcess:
         # 4. PUSH to current neighbors (node_process.py:227-232)
         payload = pack_state(out_flat)
         for nid in neighbors:
-            try:
-                self._push_to(nid).send_multipart(
-                    encode(MsgType.MODEL_STATE, self.node_id, payload, round_idx),
-                    copy=False,
-                )
-            except Exception as e:  # pragma: no cover - socket teardown races
-                print(f"[node {self.node_id}] push to {nid} failed: {e}", flush=True)
+            self._send_to(
+                nid, encode(MsgType.MODEL_STATE, self.node_id, payload, round_idx)
+            )
 
         # 5. collect neighbor states until expected or deadline
         # (node_process.py:249-276)
@@ -296,6 +412,56 @@ class NodeProcess:
 
         # 7. evaluate + metrics to monitor
         self._send_metrics(round_idx, skipped=False)
+
+    def _reject_nonfinite(self, sender: int, state: np.ndarray) -> bool:
+        """Receiver-side sentinel (faults.nan_quarantine): drop a neighbor
+        state carrying non-finite values before it reaches any rule math
+        (0 * nan == nan in every Gram/matmul path) — defense in depth
+        behind the sender-side rollback, and the only line of defense
+        against a peer running without the sentinel."""
+        if (
+            self.config.faults.enabled
+            and self.config.faults.nan_quarantine
+            and not np.isfinite(state).all()
+        ):
+            print(
+                f"[node {self.node_id}] dropped non-finite state from "
+                f"{sender}",
+                flush=True,
+            )
+            return True
+        return False
+
+    def _send_to(self, neighbor_id: int, frames, attempts: int = 3) -> bool:
+        """Send with exponential-backoff reconnect.
+
+        A PUSH socket wedged by a peer restart (stale IPC inode, refused
+        TCP connect at send time) raises; dropping the cached socket and
+        reconnecting fresh is the recovery — ZMQ re-resolves the endpoint.
+        Failure after the retry budget degrades to the round's
+        partial-aggregation semantics (the peer just misses this state).
+        """
+        delay = 0.05
+        for attempt in range(attempts):
+            try:
+                self._push_to(neighbor_id).send_multipart(frames, copy=False)
+                return True
+            except Exception as e:
+                print(
+                    f"[node {self.node_id}] push to {neighbor_id} failed "
+                    f"(attempt {attempt + 1}/{attempts}): {e}",
+                    flush=True,
+                )
+                sock = self._push.pop(neighbor_id, None)
+                if sock is not None:
+                    try:
+                        sock.close(linger=0)
+                    except Exception:  # pragma: no cover - teardown races
+                        pass
+                if attempt + 1 < attempts:
+                    time.sleep(delay)
+                    delay *= 2
+        return False
 
     def _attacked_state(self, flat: np.ndarray, round_idx: int) -> np.ndarray:
         if self.attack is None or not self.is_compromised:
@@ -330,18 +496,18 @@ class NodeProcess:
         """
         import zmq
         peers = sorted(self.compromised_ids - {self.node_id})
+        if self.fault_schedule is not None:
+            # Dead colluders can neither contribute nor receive: shrink
+            # the coalition instead of burning half the round window
+            # waiting on them.
+            alive = self.fault_schedule.alive_at(round_idx)
+            peers = [p for p in peers if alive[p] > 0]
         payload = pack_state(flat)
         for nid in peers:
-            try:
-                self._push_to(nid).send_multipart(
-                    encode(MsgType.COLLUDE_STATE, self.node_id, payload, round_idx),
-                    copy=False,
-                )
-            except Exception as e:  # pragma: no cover - socket teardown races
-                print(
-                    f"[node {self.node_id}] collude push to {nid} failed: {e}",
-                    flush=True,
-                )
+            self._send_to(
+                nid,
+                encode(MsgType.COLLUDE_STATE, self.node_id, payload, round_idx),
+            )
 
         coalition: Dict[int, np.ndarray] = {self.node_id: np.asarray(flat)}
         prebuffered: Dict[int, np.ndarray] = {}
@@ -360,9 +526,13 @@ class NodeProcess:
             if msg_round != round_idx:
                 continue  # straggler from an earlier round window
             if msg_type == MsgType.COLLUDE_STATE and sender in peers:
-                coalition[sender] = unpack_state(data)
+                state = unpack_state(data)
+                if not self._reject_nonfinite(sender, state):
+                    coalition[sender] = state
             elif msg_type == MsgType.MODEL_STATE:
-                prebuffered[sender] = unpack_state(data)
+                state = unpack_state(data)
+                if not self._reject_nonfinite(sender, state):
+                    prebuffered[sender] = state
         missing = set(peers) - set(coalition)
         if missing:
             print(
@@ -420,7 +590,11 @@ class NodeProcess:
                     and sender in expected
                     and msg_round == round_idx
                 ):
-                    received[sender] = unpack_state(payload)
+                    state = unpack_state(payload)
+                    if self._reject_nonfinite(sender, state):
+                        expected = expected - {sender}
+                        continue
+                    received[sender] = state
         missing = expected - set(received)
         if missing:
             print(
@@ -429,6 +603,64 @@ class NodeProcess:
                 flush=True,
             )
         return received
+
+    # ------------------------------------------------------------------
+    # crash-recovery checkpoints (faults.enabled runs)
+
+    def _save_node_checkpoint(self, round_idx: int) -> None:
+        """Atomically snapshot this node's state after a completed round.
+
+        Flat params + RNG key + per-node ('node'-kind) aggregator state;
+        per-edge trust is deliberately not persisted — a recovered peer
+        re-earns link trust, which is the conservative (Byzantine-safe)
+        choice.  fsync'd write + os.replace so a crash mid-save leaves the
+        previous checkpoint intact (utils/checkpoint.py semantics).
+        """
+        import io
+
+        from murmura_tpu.utils.checkpoint import durable_replace
+
+        path = self.endpoints.node_checkpoint_path(self.node_id)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        payload = {
+            "flat": self.node.get_flat_state(),
+            "rng": np.asarray(self.node.rng),
+            "round": np.int64(round_idx),
+        }
+        for k, v in getattr(self.node, "_node_state", {}).items():
+            payload[f"node_state.{k}"] = np.asarray(v)
+        buf = io.BytesIO()
+        np.savez(buf, **payload)
+        durable_replace(
+            os.path.dirname(path), os.path.basename(path), buf.getvalue()
+        )
+
+    def _restore_node_checkpoint(self) -> Optional[int]:
+        """Restore the last checkpoint; returns its round or None."""
+        import jax.numpy as jnp
+
+        path = self.endpoints.node_checkpoint_path(self.node_id)
+        if not os.path.exists(path):
+            print(
+                f"[node {self.node_id}] resume requested but no checkpoint "
+                f"at {path}; rejoining from the initial model",
+                flush=True,
+            )
+            return None
+        with np.load(path) as data:
+            self.node.set_flat_state(data["flat"])
+            self.node.rng = jnp.asarray(data["rng"])
+            for k in list(getattr(self.node, "_node_state", {})):
+                key = f"node_state.{k}"
+                if key in data:
+                    self.node._node_state[k] = np.asarray(data[key])
+            restored = int(data["round"])
+        print(
+            f"[node {self.node_id}] restored checkpoint from round "
+            f"{restored}",
+            flush=True,
+        )
+        return restored
 
     def _send_metrics(self, round_idx: int, skipped: bool) -> None:
         metrics = {"round": round_idx, "node": self.node_id, "skipped": skipped}
@@ -450,6 +682,7 @@ def run_single_node(
     t_start: float,
     run_id: str,
     host: Optional[str] = None,
+    resume: bool = False,
 ) -> None:
     """Multi-machine worker entry (reference: cli.py:143-208).  The operator
     copies run_id/t_start printed by the head node; t_start must be valid on
@@ -473,4 +706,5 @@ def run_single_node(
         t_start=t_start,
         compromised_ids=compromised,
         host=host,
+        resume=resume,
     ).run()

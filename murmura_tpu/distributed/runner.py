@@ -26,7 +26,7 @@ def _monitor_main(config: Config, run_id: str, t_start: float,
 
 
 def _node_main(config: Config, node_id: int, run_id: str, t_start: float,
-               compromised: List[int]) -> None:
+               compromised: List[int], resume: bool = False) -> None:
     from murmura_tpu.distributed.node_process import NodeProcess
 
     # DMTT configs get the trust-protocol process (reference: runner.py:88-103)
@@ -42,6 +42,7 @@ def _node_main(config: Config, node_id: int, run_id: str, t_start: float,
         run_id=run_id,
         t_start=t_start,
         compromised_ids=compromised,
+        resume=resume,
     ).run()
 
 
@@ -60,6 +61,13 @@ class DistributedRunner:
         self.t_start: float = 0.0
         self._monitor = None
         self._queue = None
+        # Fault-injection state (config.faults.enabled with churn): the
+        # injector thread SIGKILLs scheduled nodes mid-round and respawns
+        # them (resume-from-checkpoint) at their scheduled recovery.
+        self.injector = None
+        self._ctx = None
+        self._run_id = None
+        self._compromised: List[int] = []
 
     def run(self) -> Dict[str, List[Any]]:
         self.start()
@@ -131,6 +139,9 @@ class DistributedRunner:
         )
 
         ctx = mp.get_context("spawn")
+        self._ctx = ctx
+        self._run_id = run_id
+        self._compromised = compromised
         self._queue = ctx.Queue()
         self._monitor = ctx.Process(
             target=_monitor_main,
@@ -157,6 +168,67 @@ class DistributedRunner:
             else:
                 os.environ[k] = v
 
+        from murmura_tpu.utils.factories import build_fault_schedule
+
+        schedule = build_fault_schedule(cfg)
+        if schedule is not None and cfg.faults.crash_prob > 0:
+            from murmura_tpu.faults.injector import FaultInjector
+
+            self.injector = FaultInjector(
+                schedule,
+                rounds=cfg.experiment.rounds,
+                round_duration=cfg.distributed.round_duration_s,
+                t_start=t_start,
+                kill=self._kill_node,
+                respawn=self._respawn_node,
+            )
+            self.injector.start()
+
+    def _kill_node(self, node_id: int) -> None:
+        """SIGKILL a node's current process (FaultInjector callback)."""
+        import os
+        import signal
+
+        p = self.node_procs[node_id]
+        if p.is_alive():
+            os.kill(p.pid, signal.SIGKILL)
+
+    def _respawn_node(self, node_id: int) -> None:
+        """Start a fresh resume-from-checkpoint process for a recovering
+        node (FaultInjector callback).  Same TPU-env strip/restore dance as
+        start(): spawn inherits os.environ at process creation (there is no
+        per-Process env with multiprocessing, and the axon sitecustomize
+        registers at interpreter start, before any child code could strip
+        it).  Runs on the injector watcher thread, so a host that embeds
+        DistributedRunner and touches JAX_PLATFORMS/PALLAS_AXON_POOL_IPS on
+        another thread mid-run can observe the brief strip window; the CLI
+        single-run path cannot."""
+        import os
+
+        old = self.node_procs[node_id]
+        if old.is_alive():  # pragma: no cover - schedule/kill race
+            return
+        saved_env = {
+            k: os.environ.get(k) for k in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS")
+        }
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            p = self._ctx.Process(
+                target=_node_main,
+                args=(self.config, node_id, self._run_id, self.t_start,
+                      self._compromised, True),
+                daemon=False,
+            )
+            p.start()
+            self.node_procs[node_id] = p
+        finally:
+            for k, v in saved_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
     def wait(self) -> Dict[str, List[Any]]:
         cfg = self.config
         history: Dict[str, List[Any]] = {}
@@ -173,6 +245,8 @@ class DistributedRunner:
             while not self._queue.empty():
                 history = self._queue.get_nowait()
         finally:
+            if self.injector is not None:
+                self.injector.stop()
             for p in self.node_procs:
                 p.join(timeout=5.0)
             for p in self.node_procs:

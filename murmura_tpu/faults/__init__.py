@@ -1,0 +1,11 @@
+"""Fault-injection & churn subsystem.
+
+Deterministic, seeded operational faults — node crash/recovery churn, link
+drops, stragglers, NaN quarantine — composing into every backend without
+touching the compiled round's structure (docs/ROBUSTNESS.md).
+"""
+
+from murmura_tpu.faults.injector import FaultInjector
+from murmura_tpu.faults.schedule import FaultSchedule, FaultSpec
+
+__all__ = ["FaultSchedule", "FaultSpec", "FaultInjector"]

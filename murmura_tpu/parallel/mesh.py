@@ -106,16 +106,22 @@ def _shard_leading_axis(tree: Any, node_sharding, replicated) -> Any:
     return jax.tree_util.tree_map(spec, tree)
 
 
-def _shard_round_fn(fn, program, mesh: Mesh, adj_sharding, donate: bool):
+def _shard_round_fn(
+    fn, program, mesh: Mesh, adj_sharding, donate: bool, alive_sharding=None
+):
     """Shared jit wrapper for round-shaped programs.
 
     Both the per-round step and the fused multi-round scan take
     (params, agg_state, key, <adjacency>, compromised, round, data) and
     return (params, agg_state, metrics); only the adjacency argument's
-    sharding differs.  Outputs: params/agg_state stay node-sharded; the
-    small per-node metrics arrays are replicated so the orchestrator's
-    device_get works when the mesh spans multiple processes (multi-host: a
-    node-sharded output would span non-addressable devices).
+    sharding differs.  Faulted programs (``program.faulted``) take an
+    extra per-round alive mask after ``compromised`` whose sharding is
+    supplied as ``alive_sharding`` ([N] node-sharded for the step,
+    [chunk, N] second-axis-sharded for the fused scan).  Outputs:
+    params/agg_state stay node-sharded; the small per-node metrics arrays
+    are replicated so the orchestrator's device_get works when the mesh
+    spans multiple processes (multi-host: a node-sharded output would span
+    non-addressable devices).
     """
     n_dev = mesh.devices.size
     if program.num_nodes % n_dev != 0:
@@ -128,7 +134,7 @@ def _shard_round_fn(fn, program, mesh: Mesh, adj_sharding, donate: bool):
     agg_s = _shard_leading_axis(program.init_agg_state, node_s, repl)
     data_s = _shard_leading_axis(program.data_arrays, node_s, repl)
 
-    in_shardings = (
+    in_shardings = [
         params_s,  # params
         agg_s,  # agg_state
         repl,  # rng key
@@ -136,10 +142,12 @@ def _shard_round_fn(fn, program, mesh: Mesh, adj_sharding, donate: bool):
         node_s,  # compromised mask
         repl,  # round index
         data_s,  # data dict
-    )
+    ]
+    if program.faulted:
+        in_shardings.insert(5, alive_sharding)  # alive mask / alive stack
     return jax.jit(
         fn,
-        in_shardings=in_shardings,
+        in_shardings=tuple(in_shardings),
         out_shardings=(params_s, agg_s, repl),
         donate_argnums=(0, 1) if donate else (),
     )
@@ -150,7 +158,8 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
 
     Args:
         step: the traced round function (params, agg_state, key, adj,
-            compromised, round_idx, data) -> (params, agg_state, metrics).
+            compromised, round_idx, data) -> (params, agg_state, metrics)
+            — faulted programs take an [N] alive mask after compromised.
         program: RoundProgram (for example structures to derive shardings).
         mesh: 1-D ``nodes`` mesh; program.num_nodes must be divisible by its
             size.
@@ -159,7 +168,9 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
         The compiled step with in/out shardings pinned.
     """
     node_s, _ = make_shardings(mesh)
-    return _shard_round_fn(step, program, mesh, node_s, donate)
+    return _shard_round_fn(
+        step, program, mesh, node_s, donate, alive_sharding=node_s
+    )
 
 
 def adj_stack_sharding(mesh: Mesh) -> NamedSharding:
@@ -172,9 +183,12 @@ def adj_stack_sharding(mesh: Mesh) -> NamedSharding:
 
 def shard_multi_round(multi_round, program, mesh: Mesh, donate: bool = True):
     """Jit a fused multi-round scan (core.rounds.build_multi_round) over
-    ``mesh`` with the same node-axis layout as :func:`shard_step`."""
+    ``mesh`` with the same node-axis layout as :func:`shard_step`.  The
+    faulted alive_stack [chunk, N] shares the adj_stack's layout: sharded
+    on its second (node) axis."""
     return _shard_round_fn(
-        multi_round, program, mesh, adj_stack_sharding(mesh), donate
+        multi_round, program, mesh, adj_stack_sharding(mesh), donate,
+        alive_sharding=adj_stack_sharding(mesh),
     )
 
 

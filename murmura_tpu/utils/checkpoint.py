@@ -67,13 +67,40 @@ def save_checkpoint(
     # Each file is replaced atomically, but the pair is not: a crash between
     # the two os.replace calls leaves NEW state beside OLD meta.  The round
     # number embedded in the blob lets restore detect that torn pair.
-    tmp_state = d / (STATE_FILE + ".tmp")
-    tmp_state.write_bytes(blob)
-    os.replace(tmp_state, d / STATE_FILE)
-    tmp_meta = d / (META_FILE + ".tmp")
-    tmp_meta.write_text(meta)
-    os.replace(tmp_meta, d / META_FILE)
+    durable_replace(d, STATE_FILE, blob)
+    durable_replace(d, META_FILE, meta.encode("utf-8"))
     return d
+
+
+def durable_replace(directory: str | Path, name: str, data: bytes) -> None:
+    """Write ``directory/name`` via a temp file so a crash at ANY point
+    leaves either the old complete file or the new complete file.
+
+    os.replace alone only gives atomicity against concurrent readers; a
+    HOST crash can still lose the rename (or land an empty/partial temp
+    file in it) unless the temp file's data is fsync'd before the rename
+    and the directory entry is fsync'd after it.  Shared with the ZMQ
+    backend's per-node crash-recovery checkpoints
+    (distributed/node_process.py) — one durability path, not two.
+    """
+    directory = Path(directory)
+    tmp = directory / (name + ".tmp")
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o644)
+    try:
+        # os.write may write short (kernel caps one write at ~2 GiB;
+        # EINTR): loop until every byte is down before the fsync.
+        view = memoryview(data)
+        while view:
+            view = view[os.write(fd, view):]
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+    os.replace(tmp, directory / name)
+    dfd = os.open(directory, os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
 
 
 def restore_checkpoint(

@@ -166,6 +166,45 @@ def build_mobility(config: Config) -> Optional[MobilityModel]:
     )
 
 
+def build_fault_schedule(config: Config):
+    """FaultSchedule from config.faults, or None when the model is off.
+
+    The single construction path for EVERY consumer — the simulation/tpu
+    orchestrator, each ZMQ node process, and the runner's FaultInjector —
+    so the deterministic schedule is identical across processes and
+    backends by construction (faults/schedule.py module docstring).
+    """
+    f = config.faults
+    if not f.enabled:
+        return None
+    from murmura_tpu.faults.schedule import FaultSchedule
+
+    return FaultSchedule(
+        config.topology.num_nodes,
+        crash_prob=f.crash_prob,
+        recovery_prob=f.recovery_prob,
+        min_down_rounds=f.min_down_rounds,
+        link_drop_prob=f.link_drop_prob,
+        straggler_prob=f.straggler_prob,
+        straggler_factor=f.straggler_factor,
+        seed=f.seed,
+    )
+
+
+def build_fault_spec(config: Config):
+    """Trace-time FaultSpec from config.faults, or None when off."""
+    f = config.faults
+    if not f.enabled:
+        return None
+    from murmura_tpu.faults.schedule import FaultSpec
+
+    return FaultSpec(
+        nan_quarantine=f.nan_quarantine,
+        nan_inject_nodes=tuple(f.nan_inject_nodes),
+        nan_inject_from_round=f.nan_inject_from_round,
+    )
+
+
 class ConfigError(ValueError):
     """Wiring-level configuration error: the config validated structurally
     but its pieces cannot work together (data/model mismatch, unsupported
@@ -397,6 +436,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         dmtt=dmtt,
         param_dtype=resolved_param_dtype(config),
         node_axis_sharded=_node_axis_sharded(config, mesh),
+        faults=build_fault_spec(config),
     )
 
     if config.backend == "tpu" and mesh is None:
@@ -416,4 +456,5 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         profile_dir=config.tpu.profile_dir,
         recompile_guard=config.tpu.recompile_guard,
         transfer_guard=config.tpu.transfer_guard,
+        fault_schedule=build_fault_schedule(config),
     )

@@ -9,6 +9,8 @@
 # bench_breakdown/bench_scaling write their committed artifacts only when
 # they actually ran (breakdown always writes; check "backend" in the JSON).
 set -u
+CHAOS=0
+if [ "${1:-}" = "--chaos" ]; then CHAOS=1; shift; fi
 OUT="${1:-/root/repo/tpu_battery_results}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")"
@@ -35,6 +37,24 @@ if ! timeout 600 env JAX_PLATFORMS=cpu python -m murmura_tpu check --ir murmura_
   exit 1
 fi
 echo "preflight check clean" | tee -a "$OUT/battery.log"
+# Optional chaos pre-flight (./run_tpu_battery.sh --chaos [outdir]): the
+# full operational-fault gauntlet — 20% Markov churn, link drops,
+# stragglers, one NaN-injecting node, gaussian Byzantine noise — must
+# complete end-to-end (docs/ROBUSTNESS.md) before the battery spends chip
+# time: a regression in the fault masks or the NaN sentinel invalidates
+# the robustness story every bench number rides on.  CPU-pinned like the
+# static gate.
+if [ "$CHAOS" = 1 ]; then
+  echo "=== preflight: chaos smoke ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 900 env JAX_PLATFORMS=cpu python -m murmura_tpu run \
+      examples/configs/chaos_churn.yaml --quiet \
+      -o "$OUT/chaos_history.json" > "$OUT/preflight_chaos.out" 2>&1; then
+    echo "preflight chaos smoke FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_chaos.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight chaos smoke clean" | tee -a "$OUT/battery.log"
+fi
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py
 run breakdown256   2400 python bench_breakdown.py --nodes 256

@@ -83,6 +83,38 @@ def test_torn_checkpoint_detected(tmp_path):
         fresh.restore_checkpoint(str(ckpt))
 
 
+def test_torn_pair_new_state_old_meta_detected(tmp_path):
+    """The exact torn pair the save-path docstring promises to catch: a
+    crash landing BETWEEN the two os.replace calls leaves the NEW
+    state.msgpack beside the OLD meta.json.  Reproduced with two real
+    checkpoints (not hand-edited JSON): splice the round-2 meta next to
+    the round-4 state and restore must refuse loudly."""
+    import pytest
+
+    ckpt = tmp_path / "ckpt"
+    net = _make_network()
+    net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    old_meta = (ckpt / "meta.json").read_bytes()
+    net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    (ckpt / "meta.json").write_bytes(old_meta)  # crash before meta replace
+
+    fresh = _make_network()
+    with pytest.raises(ValueError, match="[Tt]orn"):
+        fresh.restore_checkpoint(str(ckpt))
+
+
+def test_save_leaves_no_temp_files(tmp_path):
+    """The fsync'd write path must clean up its .tmp staging files — a
+    leftover would be restored as garbage by naive directory scans and
+    signals a torn write sequence."""
+    ckpt = tmp_path / "ckpt"
+    net = _make_network()
+    net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    leftovers = list(ckpt.glob("*.tmp"))
+    assert not leftovers, leftovers
+    assert has_checkpoint(ckpt)
+
+
 def test_krum_f_num_compromised_conflict():
     import pytest
 

@@ -322,6 +322,156 @@ class TestFaultInjection:
             assert accs[-1] >= earlier.max() - 0.05, history
 
 
+class TestNodeDeadline:
+    """Unit-level NodeProcess round semantics (no sockets, no subprocess)."""
+
+    class _FakePush:
+        def __init__(self):
+            self.frames = []
+
+        def send_multipart(self, frames, **kw):
+            self.frames.append(list(frames))
+
+    def _node(self, t_start):
+        from murmura_tpu.distributed.node_process import NodeProcess
+
+        cfg = Config.model_validate(
+            {
+                "experiment": {"name": "dl", "seed": 0, "rounds": 3},
+                "topology": {"type": "ring", "num_nodes": 3},
+                "aggregation": {"algorithm": "fedavg"},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "distributed",
+                "distributed": {"transport": "ipc", "round_duration_s": 10.0},
+            }
+        )
+        proc = NodeProcess(cfg, node_id=0, run_id="dl-test",
+                           t_start=t_start, compromised_ids=[])
+        proc._monitor_push = self._FakePush()
+        return proc
+
+    def test_past_deadline_round_publishes_skipped_frame(self):
+        """A node already past its round deadline (previous round overran
+        the whole window, or a recovery boot landed late) must publish a
+        SKIPPED metrics frame — keeping the monitor index-aligned —
+        instead of training into the next window and silently advancing.
+        self.node stays None: touching it (i.e. training) would raise."""
+        from murmura_tpu.distributed.messaging import decode, unpack_obj
+
+        proc = self._node(t_start=time.monotonic() - 1000.0)
+        proc._execute_round(0)  # round-0 deadline long gone
+        assert len(proc._monitor_push.frames) == 1
+        msg_type, sender, msg_round, payload = decode(
+            proc._monitor_push.frames[0]
+        )
+        metrics = unpack_obj(payload)
+        assert metrics["round"] == 0 and metrics["node"] == 0
+        assert metrics["skipped"] is True
+
+
+class TestDistributedNaNQuarantine:
+    """The ZMQ twin of the in-jit sentinel (docs/ROBUSTNESS.md §2b):
+    sender-side rollback of a divergent local step, receiver-side drop of
+    non-finite arrivals."""
+
+    def _cfg(self, faults):
+        return Config.model_validate(
+            {
+                "experiment": {"name": "q", "seed": 0, "rounds": 3},
+                "topology": {"type": "ring", "num_nodes": 3},
+                "aggregation": {"algorithm": "fedavg"},
+                "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+                "data": {"adapter": "synthetic",
+                          "params": {"num_samples": 64, "input_dim": 4,
+                                     "num_classes": 2}},
+                "model": {"factory": "mlp",
+                           "params": {"input_dim": 4, "hidden_dims": [4],
+                                      "num_classes": 2}},
+                "backend": "distributed",
+                "distributed": {"transport": "ipc",
+                                 "round_duration_s": 30.0},
+                "faults": faults,
+            }
+        )
+
+    def test_sender_rolls_back_divergent_update(self, tmp_path):
+        """nan_inject on self: the node must roll back to its pre-round
+        params, skip the exchange, and still report metrics."""
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.distributed.local import LocalNode
+        from murmura_tpu.distributed.messaging import decode, unpack_obj
+        from murmura_tpu.distributed.node_process import NodeProcess
+        from murmura_tpu.models.mlp import make_mlp
+
+        cfg = self._cfg({"enabled": True, "nan_quarantine": True,
+                          "nan_inject_nodes": [0]})
+        cfg.distributed.ipc_dir = str(tmp_path)
+        proc = NodeProcess(cfg, node_id=0, run_id="q-test",
+                           t_start=time.monotonic(), compromised_ids=[])
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(64, 4)).astype(np.float32)
+        y = rng.integers(0, 2, size=64).astype(np.int32)
+        proc.node = LocalNode(
+            0, make_mlp(4, (8,), 2), build_aggregator("fedavg", {}),
+            x, y, max_neighbors=2, batch_size=8, lr=0.1, seed=0,
+        )
+        proc.static_neighbors = [1, 2]
+        proc._monitor_push = TestNodeDeadline._FakePush()
+        before = proc.node.get_flat_state()
+        proc._execute_round(0)
+        np.testing.assert_array_equal(proc.node.get_flat_state(), before)
+        # Still reporting: one non-skipped metrics frame.
+        assert len(proc._monitor_push.frames) == 1
+        metrics = unpack_obj(decode(proc._monitor_push.frames[0])[3])
+        assert metrics["skipped"] is False
+        assert np.isfinite(metrics["loss"])
+
+    def test_receiver_drops_nonfinite_state(self, tmp_path):
+        """A NaN state from a peer (e.g. one running without the sentinel)
+        must be dropped before any rule math, and the collect loop must
+        not keep waiting on that peer."""
+        import zmq
+
+        from murmura_tpu.distributed.messaging import (
+            MsgType, encode, pack_state,
+        )
+        from murmura_tpu.distributed.node_process import NodeProcess
+
+        cfg = self._cfg({"enabled": True, "nan_quarantine": True})
+        cfg.distributed.ipc_dir = str(tmp_path)
+        proc = NodeProcess(cfg, node_id=0, run_id="q-recv",
+                           t_start=time.monotonic(), compromised_ids=[])
+        ctx = zmq.Context()
+        try:
+            pull = ctx.socket(zmq.PULL)
+            endpoint = f"ipc://{tmp_path}/recv_test"
+            pull.bind(endpoint)
+            push = ctx.socket(zmq.PUSH)
+            push.connect(endpoint)
+            proc._pull = pull
+            bad = np.full(10, np.nan, np.float32)
+            good = np.ones(10, np.float32)
+            push.send_multipart(encode(MsgType.MODEL_STATE, 1,
+                                        pack_state(bad), 0))
+            push.send_multipart(encode(MsgType.MODEL_STATE, 2,
+                                        pack_state(good), 0))
+            received = proc._collect_states(
+                {1, 2}, 0, deadline=time.monotonic() + 10.0
+            )
+            assert set(received) == {2}
+            np.testing.assert_array_equal(received[2], good)
+            push.close()
+            pull.close()
+        finally:
+            ctx.term()
+
+
 class TestMonitorFlush:
     """Unit-level Monitor semantics (no sockets): complete rounds flush in
     order, partial rounds flush at the hard deadline with degradation
@@ -393,6 +543,26 @@ class TestMonitorFlush:
         mon._flush_complete()
         mon._flush_partial()
         assert mon.history["round"] == [1]
+
+    def test_flush_partial_clamps_corrupt_buffered_round_tag(self):
+        # The clamp inside _flush_partial is the second line of defense
+        # behind _ingest's range check: a corrupt round tag that lands in
+        # the buffer anyway (future ingest paths, direct feeds) must not
+        # drive a ~10^9-iteration NaN-row loop.  Feed the buffer directly
+        # so the clamp itself — not the ingest filter — is under test.
+        mon = self._monitor(nodes=2, rounds=3)
+        for node in range(2):
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                          "loss": 1.0})
+        mon._buffer[10**9] = {
+            0: {"round": 10**9, "node": 0, "accuracy": 0.1, "loss": 9.9}
+        }
+        mon._flush_complete()
+        mon._flush_partial()
+        # Gap-filled NaN rows reach the configured horizon and STOP there.
+        assert mon.history["round"] == [1, 2, 3]
+        assert mon.history["reporting_nodes"] == [2, 0, 0]
+        assert not mon._buffer
 
     def test_all_skipped_round_records_nan_row(self):
         mon = self._monitor(nodes=2, rounds=1)

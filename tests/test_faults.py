@@ -1,0 +1,465 @@
+"""Fault-injection & churn subsystem (murmura_tpu/faults/).
+
+Covers the ISSUE-3 acceptance surface on the jitted backends:
+
+- FaultSchedule determinism (same seed => identical masks, in-process and
+  across a fresh interpreter) and the monotone churn property
+  (recovery_prob=0 => dead stays dead);
+- masked-adjacency semantics (zero diagonal, edge removal only, straggler
+  columns, symmetric link drops);
+- default-off bit-identity: a config without a ``faults`` block and one
+  with ``enabled: false`` produce byte-identical histories;
+- the in-jit NaN sentinel: quarantine + rollback, counts surfaced in
+  history, NaN spread when the sentinel is disabled (the negative that
+  proves the sentinel is the thing containing it);
+- the chaos smoke: 20% Markov churn + one NaN-injecting node over 20
+  rounds completes, quarantines, and still learns (tier-1 CI gate);
+- zero new recompiles under CompileTracker as alive masks vary, and fused
+  multi-round dispatch parity.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from murmura_tpu.config import Config
+from murmura_tpu.faults.schedule import FaultSchedule, FaultSpec
+from murmura_tpu.utils.factories import (
+    build_fault_schedule,
+    build_network_from_config,
+)
+
+
+def _base_cfg(**overrides):
+    cfg = {
+        "experiment": {"name": "faults", "seed": 3, "rounds": 6},
+        "topology": {"type": "ring", "num_nodes": 8},
+        "aggregation": {"algorithm": "fedavg"},
+        "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+        "data": {
+            "adapter": "synthetic",
+            "params": {"num_samples": 640, "input_dim": 16, "num_classes": 4},
+        },
+        "model": {
+            "factory": "mlp",
+            "params": {"input_dim": 16, "hidden_dims": [16], "num_classes": 4},
+        },
+        "backend": "simulation",
+    }
+    cfg.update(overrides)
+    return Config.model_validate(cfg)
+
+
+CHAOS_FAULTS = {
+    "enabled": True,
+    "seed": 5,
+    "crash_prob": 0.2,
+    "recovery_prob": 0.5,
+    "nan_inject_nodes": [2],
+}
+
+
+class TestFaultSchedule:
+    def test_same_seed_identical_masks(self):
+        a = FaultSchedule(8, crash_prob=0.3, recovery_prob=0.4,
+                          link_drop_prob=0.2, straggler_prob=0.2, seed=9)
+        b = FaultSchedule(8, crash_prob=0.3, recovery_prob=0.4,
+                          link_drop_prob=0.2, straggler_prob=0.2, seed=9)
+        for r in range(30):
+            np.testing.assert_array_equal(a.alive_at(r), b.alive_at(r))
+            np.testing.assert_array_equal(a.link_mask_at(r), b.link_mask_at(r))
+            np.testing.assert_array_equal(a.straggler_at(r), b.straggler_at(r))
+
+    def test_lazy_extension_matches_eager(self):
+        # Asking for round 20 first, then round 3, must agree with a
+        # sequential walk — the schedule is a pure function of the seed.
+        a = FaultSchedule(6, crash_prob=0.3, recovery_prob=0.4, seed=1)
+        b = FaultSchedule(6, crash_prob=0.3, recovery_prob=0.4, seed=1)
+        late = a.alive_at(20)
+        for r in range(21):
+            b.alive_at(r)
+        np.testing.assert_array_equal(late, b.alive_at(20))
+        np.testing.assert_array_equal(a.alive_at(3), b.alive_at(3))
+
+    def test_cross_process_determinism(self):
+        """Same seed => identical schedule in a fresh interpreter — the
+        property every ZMQ node process and the injector lean on."""
+        a = FaultSchedule(6, crash_prob=0.25, recovery_prob=0.5,
+                          link_drop_prob=0.15, straggler_prob=0.1, seed=17)
+        stack = np.stack([a.alive_at(r) for r in range(12)])
+        out = subprocess.run(
+            [sys.executable, "-c", (
+                "import numpy as np\n"
+                "from murmura_tpu.faults.schedule import FaultSchedule\n"
+                "s = FaultSchedule(6, crash_prob=0.25, recovery_prob=0.5,"
+                " link_drop_prob=0.15, straggler_prob=0.1, seed=17)\n"
+                "print(repr(np.stack([s.alive_at(r) for r in range(12)])"
+                ".tobytes().hex()))"
+            )],
+            capture_output=True, text=True, timeout=120,
+        )
+        assert out.returncode == 0, out.stderr
+        assert out.stdout.strip().strip("'") == stack.tobytes().hex()
+
+    def test_backends_share_one_construction_path(self):
+        """Simulation/tpu (Network wiring) and distributed (NodeProcess,
+        FaultInjector) all build their schedule through
+        build_fault_schedule, so equality of two calls IS the cross-backend
+        contract."""
+        cfg = _base_cfg(faults=dict(CHAOS_FAULTS))
+        a, b = build_fault_schedule(cfg), build_fault_schedule(cfg)
+        for r in range(15):
+            np.testing.assert_array_equal(a.alive_at(r), b.alive_at(r))
+            np.testing.assert_array_equal(a.link_mask_at(r), b.link_mask_at(r))
+
+    @pytest.mark.parametrize("seed", [0, 1, 7, 23])
+    @pytest.mark.parametrize("crash", [0.1, 0.4, 0.9])
+    def test_no_recovery_is_monotone(self, seed, crash):
+        """Property: with recovery_prob=0, churn is monotone — once a node
+        dies it stays dead for every later round."""
+        sched = FaultSchedule(10, crash_prob=crash, recovery_prob=0.0,
+                              seed=seed)
+        alive = np.stack([sched.alive_at(r) for r in range(40)])
+        # alive may only ever step 1 -> 0, never 0 -> 1
+        assert (np.diff(alive, axis=0) <= 0).all()
+
+    def test_min_down_rounds_enforced(self):
+        sched = FaultSchedule(50, crash_prob=0.5, recovery_prob=1.0,
+                              min_down_rounds=3, seed=2)
+        alive = np.stack([sched.alive_at(r) for r in range(30)]) > 0
+        dead_runs = []
+        for node in range(50):
+            run = 0
+            for r in range(30):
+                if not alive[r, node]:
+                    run += 1
+                elif run:
+                    dead_runs.append(run)
+                    run = 0
+        assert dead_runs, "crash_prob=0.5 produced no completed downtime"
+        # recovery_prob=1.0 recovers at the first eligible draw, which is
+        # the round AFTER min_down_rounds have elapsed.
+        assert min(dead_runs) >= 3
+
+    def test_masked_adjacency_semantics(self):
+        from murmura_tpu.topology.generators import create_topology
+
+        adj = create_topology("fully", num_nodes=6).mask()
+        sched = FaultSchedule(6, crash_prob=0.4, recovery_prob=0.3,
+                              link_drop_prob=0.3, straggler_prob=0.3, seed=4)
+        for r in range(12):
+            m = sched.masked_adjacency(adj, r)
+            assert not m.diagonal().any()
+            assert (m <= adj).all() and (m >= 0).all()
+            alive = sched.alive_at(r)
+            dead = np.flatnonzero(alive <= 0)
+            assert not m[dead, :].any() and not m[:, dead].any()
+            stragglers = np.flatnonzero(sched.straggler_at(r))
+            assert not m[:, stragglers].any()  # outgoing dropped...
+            link = sched.link_mask_at(r)
+            np.testing.assert_array_equal(link, link.T)  # symmetric drops
+            assert (m <= link).all()
+
+    def test_straggler_keeps_own_row(self):
+        # ...but a straggler still aggregates what it received (row kept)
+        # when it is alive and its inbound links/peers are up.
+        adj = np.ones((4, 4), np.float32) - np.eye(4, dtype=np.float32)
+        sched = FaultSchedule(4, straggler_prob=0.5, seed=11)
+        found = False
+        for r in range(30):
+            stragglers = np.flatnonzero(sched.straggler_at(r))
+            m = sched.masked_adjacency(adj, r)
+            others = [i for i in range(4) if i not in stragglers]
+            for i in stragglers:
+                if m[i, others].any():
+                    found = True
+        assert found
+
+    def test_alive_stack_matches_per_round(self):
+        sched = FaultSchedule(5, crash_prob=0.3, recovery_prob=0.5, seed=8)
+        stack = sched.alive_stack(2, 4)
+        for i in range(4):
+            np.testing.assert_array_equal(stack[i], sched.alive_at(2 + i))
+
+    def test_transition_views(self):
+        sched = FaultSchedule(8, crash_prob=0.4, recovery_prob=0.6, seed=3)
+        for r in range(1, 15):
+            prev, cur = sched.alive_at(r - 1) > 0, sched.alive_at(r) > 0
+            np.testing.assert_array_equal(sched.died_at(r), prev & ~cur)
+            np.testing.assert_array_equal(sched.recovered_at(r), ~prev & cur)
+
+    def test_invalid_probabilities_rejected(self):
+        with pytest.raises(ValueError, match="crash_prob"):
+            FaultSchedule(4, crash_prob=1.5)
+        with pytest.raises(ValueError, match="min_down_rounds"):
+            FaultSchedule(4, min_down_rounds=0)
+
+
+class TestFaultsConfig:
+    def test_nan_inject_out_of_range_rejected(self):
+        with pytest.raises(Exception, match="nan_inject_nodes"):
+            _base_cfg(faults={"enabled": True, "nan_inject_nodes": [99]})
+
+    def test_disabled_builds_nothing(self):
+        cfg = _base_cfg(faults={"enabled": False, "crash_prob": 0.5})
+        assert build_fault_schedule(cfg) is None
+        net = build_network_from_config(cfg)
+        assert net.fault_schedule is None and not net.program.faulted
+
+
+class TestDefaultOffBitIdentity:
+    def test_history_identical_without_and_with_disabled_block(self):
+        """faults absent or {enabled: false} => byte-identical run (the
+        compiled program, inputs, and random streams are untouched)."""
+        h0 = build_network_from_config(_base_cfg()).train(rounds=4)
+        h1 = build_network_from_config(
+            _base_cfg(faults={"enabled": False})
+        ).train(rounds=4)
+        assert h0 == h1
+
+
+class TestNaNSentinel:
+    def _faulted_cfg(self, **faults):
+        f = {"enabled": True, "nan_quarantine": True}
+        f.update(faults)
+        return _base_cfg(faults=f)
+
+    def test_quarantine_rolls_back_and_contains(self):
+        import jax
+
+        cfg = self._faulted_cfg(nan_inject_nodes=[2])
+        net = build_network_from_config(cfg)
+        init_flat = np.asarray(
+            jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(net.params)
+        )
+        h = net.train(rounds=3)
+        final_flat = np.asarray(
+            jax.vmap(lambda t: jax.flatten_util.ravel_pytree(t)[0])(net.params)
+        )
+        # The injected node rolled back every round: frozen at init.
+        np.testing.assert_array_equal(final_flat[2], init_flat[2])
+        # Everyone else trained and stayed finite.
+        assert np.isfinite(final_flat).all()
+        others = [i for i in range(8) if i != 2]
+        assert (np.abs(final_flat[others] - init_flat[others]).max(axis=1) > 0).all()
+        # Quarantine counts surfaced per round.
+        assert h["agg_quarantined"] == [1.0, 1.0, 1.0]
+        assert all(np.isfinite(h["mean_loss"]))
+
+    def test_injection_from_round_gates_quarantine(self):
+        cfg = self._faulted_cfg(nan_inject_nodes=[1], nan_inject_from_round=2)
+        h = build_network_from_config(cfg).train(rounds=4)
+        assert h["agg_quarantined"] == [0.0, 0.0, 1.0, 1.0]
+
+    def test_sentinel_off_poisons_the_fleet(self):
+        """The negative that proves the sentinel is the containment: with
+        nan_quarantine disabled, one diverging node NaNs the whole run."""
+        cfg = self._faulted_cfg(nan_inject_nodes=[2], nan_quarantine=False)
+        h = build_network_from_config(cfg).train(rounds=3)
+        assert not np.isfinite(h["mean_loss"][-1])
+
+    def test_dead_nodes_freeze_params(self):
+        import jax
+
+        # recovery_prob=0: once dead, frozen forever — their flat state at
+        # the end must equal their state when they died.
+        cfg = _base_cfg(
+            faults={"enabled": True, "crash_prob": 0.4,
+                    "recovery_prob": 0.0, "seed": 12},
+        )
+        net = build_network_from_config(cfg)
+        sched = net.fault_schedule
+        h = net.train(rounds=5)
+        assert len(h["round"]) == 5
+        alive_final = sched.alive_at(4)
+        assert (alive_final <= 0).any(), "seed 12 produced no deaths in 5 rounds"
+        # A node dead for rounds r..4 froze at its pre-r params; at minimum
+        # the run stayed finite and recorded the shrinking alive counts.
+        alive_counts = [float(sched.alive_at(r).sum()) for r in range(5)]
+        assert h["agg_alive"] == alive_counts
+        assert all(np.isfinite(h["mean_loss"]))
+
+
+class TestChaosSmoke:
+    def test_churn_plus_nan_node_still_learns(self):
+        """ISSUE-3 acceptance: 20% Markov churn + one NaN-injecting node
+        over 20 rounds completes without exception, quarantine counts are
+        nonzero, and final accuracy beats round 0."""
+        cfg = _base_cfg(
+            experiment={"name": "chaos", "seed": 3, "rounds": 20},
+            faults=dict(CHAOS_FAULTS),
+        )
+        h = build_network_from_config(cfg).train(rounds=20)
+        assert h["round"] == list(range(1, 21))
+        assert all(np.isfinite(h["mean_loss"]))
+        assert sum(h["agg_quarantined"]) > 0
+        assert min(h["agg_alive"]) < 8, "20% churn never took a node down"
+        assert h["mean_accuracy"][-1] > h["mean_accuracy"][0] + 0.1
+
+    def test_no_recompile_as_masks_vary(self):
+        """Alive/link-mask variation must reach the compiled step as input
+        values: zero post-warmup compiles under the recompile guard."""
+        cfg = _base_cfg(faults=dict(CHAOS_FAULTS))
+        net = build_network_from_config(cfg)
+        net.recompile_guard = True
+        net.train(rounds=5)  # raises RecompileError on any post-warmup compile
+        report = dict(net.last_compile_report)
+        assert all(c == 0 for label, c in report.items() if label != "round 0")
+
+    def test_fused_dispatch_parity(self):
+        cfg = _base_cfg(faults=dict(CHAOS_FAULTS))
+        h1 = build_network_from_config(cfg).train(rounds=6)
+        h2 = build_network_from_config(cfg).train(rounds=6, rounds_per_dispatch=3)
+        for k in ("mean_accuracy", "mean_loss", "agg_quarantined", "agg_alive"):
+            np.testing.assert_allclose(h1[k], h2[k], rtol=1e-5, atol=1e-6,
+                                       err_msg=k)
+
+    def test_simulation_tpu_equivalence_under_faults(self):
+        sim = _base_cfg(faults=dict(CHAOS_FAULTS))
+        tpu = _base_cfg(faults=dict(CHAOS_FAULTS), backend="tpu",
+                        tpu={"compute_dtype": "float32"})
+        h_sim = build_network_from_config(sim).train(rounds=4)
+        h_tpu = build_network_from_config(tpu).train(rounds=4)
+        np.testing.assert_allclose(
+            h_sim["mean_accuracy"], h_tpu["mean_accuracy"], atol=1e-4
+        )
+        np.testing.assert_allclose(
+            h_sim["agg_quarantined"], h_tpu["agg_quarantined"]
+        )
+
+    def test_zero_alive_neighbors_degrades_to_self_model(self):
+        """Total isolation (every peer dead) must not divide by zero: the
+        isolated node keeps training solo on its own model."""
+        cfg = _base_cfg(
+            topology={"type": "ring", "num_nodes": 4},
+            faults={"enabled": True, "crash_prob": 0.9,
+                    "recovery_prob": 0.1, "seed": 1},
+        )
+        net = build_network_from_config(cfg)
+        # With crash_prob 0.9 on 4 nodes some round strands a survivor
+        # with zero alive neighbors; the run must stay finite regardless.
+        h = net.train(rounds=6)
+        assert all(np.isfinite(np.asarray(h["mean_loss"])))
+
+
+class TestInjectorOrdering:
+    class _Sched:
+        """Duck-typed schedule: node 0 down for exactly ONE round (dies at
+        round 0, recovers at round 1) — the pattern that used to lose the
+        respawn forever (early respawn skipped while the old process was
+        alive, then the kill made death permanent)."""
+
+        num_nodes = 2
+
+        def died_at(self, r):
+            return np.array([r == 0, False])
+
+        def recovered_at(self, r):
+            return np.array([r == 1, False])
+
+    def test_one_round_outage_respawns_after_the_kill(self):
+        import time as _time
+
+        from murmura_tpu.faults.injector import FaultInjector
+
+        calls = []
+        inj = FaultInjector(
+            self._Sched(), rounds=2, round_duration=0.3,
+            t_start=_time.monotonic(),
+            kill=lambda i: calls.append(("kill", i)),
+            respawn=lambda i: calls.append(("respawn", i)),
+        )
+        inj.start()
+        inj._thread.join(timeout=5.0)
+        assert calls == [("kill", 0), ("respawn", 0)], calls
+        assert [(k, n) for _, k, n in inj.events] == calls
+
+    def test_longer_outage_respawns_one_round_early(self):
+        import time as _time
+
+        from murmura_tpu.faults.injector import FaultInjector
+
+        class Sched:
+            num_nodes = 1
+
+            def died_at(self, r):
+                return np.array([r == 0])
+
+            def recovered_at(self, r):
+                return np.array([r == 2])
+
+        calls = []
+        inj = FaultInjector(
+            Sched(), rounds=3, round_duration=0.3, t_start=_time.monotonic(),
+            kill=lambda i: calls.append(("kill", i, _time.monotonic())),
+            respawn=lambda i: calls.append(("respawn", i, _time.monotonic())),
+        )
+        t0 = _time.monotonic()
+        inj.start()
+        inj._thread.join(timeout=5.0)
+        assert [c[:2] for c in calls] == [("kill", 0), ("respawn", 0)]
+        # Respawn lands at the round-1 window open (one round before the
+        # scheduled round-2 recovery), giving the process a boot round.
+        assert calls[1][2] - t0 < 2 * 0.3 + 0.15
+
+
+class TestAttackNaNSentinel:
+    def test_overflowing_attack_is_scrubbed_from_the_exchange(self):
+        """Second sentinel stage: gaussian noise huge enough to overflow
+        float32 to inf in the BROADCAST (own params stay finite, so the
+        pre-attack check alone cannot see it) must not NaN the fleet."""
+        cfg = _base_cfg(
+            attack={"enabled": True, "type": "gaussian", "percentage": 0.25,
+                     "params": {"noise_std": 1e39}},
+            faults={"enabled": True},
+        )
+        h = build_network_from_config(cfg).train(rounds=3)
+        assert all(np.isfinite(h["mean_loss"])), h["mean_loss"]
+        assert all(np.isfinite(h["honest_accuracy"]))
+        # The containment is telemetry, not silent.  ALL 8 rows scrub: the
+        # attack applies noise via a compromised-mask multiply, and with
+        # inf noise the honest rows become 0 * inf == NaN too — the exact
+        # contamination mode that makes the sentinel check every row
+        # rather than trusting the compromised mask.
+        assert h["agg_attack_scrubbed"] == [8.0, 8.0, 8.0]
+        assert h["agg_quarantined"] == [0.0, 0.0, 0.0]  # no rollback
+
+
+class TestDurableReplace:
+    def test_short_writes_are_completed(self, tmp_path, monkeypatch):
+        """os.write may write short (2 GiB kernel cap, EINTR): the helper
+        must loop until every byte is down, not fsync a truncated file."""
+        from murmura_tpu.utils import checkpoint as ckpt
+
+        real_write = ckpt.os.write
+        monkeypatch.setattr(
+            ckpt.os, "write", lambda fd, data: real_write(fd, bytes(data)[:7])
+        )
+        payload = bytes(range(256)) * 20
+        ckpt.durable_replace(tmp_path, "blob.bin", payload)
+        assert (tmp_path / "blob.bin").read_bytes() == payload
+        assert not list(tmp_path.glob("*.tmp"))
+
+
+class TestFaultSpecProgram:
+    def test_faulted_flag_threads_through(self):
+        cfg = _base_cfg(faults=dict(CHAOS_FAULTS))
+        net = build_network_from_config(cfg)
+        assert net.program.faulted and net.fault_schedule is not None
+
+    def test_schedule_without_faulted_program_rejected(self):
+        from murmura_tpu.core.network import Network
+
+        plain = build_network_from_config(_base_cfg())
+        with pytest.raises(ValueError, match="fault schedule"):
+            Network(
+                program=plain.program,
+                topology=plain.topology,
+                fault_schedule=FaultSchedule(8, crash_prob=0.1),
+            )
+
+    def test_fault_spec_defaults(self):
+        spec = FaultSpec()
+        assert spec.nan_quarantine and spec.nan_inject_nodes == ()

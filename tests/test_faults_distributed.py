@@ -1,0 +1,106 @@
+"""End-to-end crash/recovery on the ZMQ distributed backend (ISSUE-3
+acceptance, distributed half): the FaultInjector SIGKILLs a scheduled node
+mid-run, survivors re-resolve expected neighbors from the schedule (no
+deadline hang on a known-dead peer), and the node rejoins from its
+per-node checkpoint at the scheduled recovery round and reports metrics
+again.
+
+Wall-clock heavy (spawned jax imports + compiles on a shared CI core) —
+marked slow, like the sibling kill test in test_distributed.py."""
+
+import time
+
+import numpy as np
+import pytest
+
+from murmura_tpu.config import Config
+from murmura_tpu.faults.schedule import FaultSchedule
+
+NODES = 4
+ROUNDS = 5
+CHURN = dict(crash_prob=0.12, recovery_prob=0.8, min_down_rounds=2)
+
+
+def _find_seed():
+    """Deterministic search for a seed whose schedule kills exactly one
+    node for rounds 1-2 and recovers it for rounds 3-4, with every other
+    node up the whole run.  Pure numpy — the same schedule every process
+    reconstructs in the run itself."""
+    for seed in range(5000):
+        s = FaultSchedule(NODES, seed=seed, **CHURN)
+        alive = np.stack([s.alive_at(r) for r in range(ROUNDS)]) > 0
+        victims = np.flatnonzero(~alive.all(axis=0))
+        if len(victims) != 1:
+            continue
+        v = victims[0]
+        if alive[0, v] and not alive[1, v] and not alive[2, v] \
+                and alive[3, v] and alive[4, v]:
+            return seed, int(v)
+    raise AssertionError("no seed produced the wanted churn pattern")
+
+
+@pytest.mark.slow
+def test_sigkill_and_checkpoint_recovery(tmp_path):
+    from murmura_tpu.distributed.runner import DistributedRunner
+
+    seed, victim = _find_seed()
+    duration = 30.0
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": "fault-recovery", "seed": 42,
+                           "rounds": ROUNDS},
+            "topology": {"type": "fully", "num_nodes": NODES},
+            "aggregation": {"algorithm": "fedavg"},
+            "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+            "data": {
+                "adapter": "synthetic",
+                "params": {"num_samples": 320, "input_dim": 16,
+                            "num_classes": 4},
+            },
+            "model": {
+                "factory": "mlp",
+                "params": {"input_dim": 16, "num_classes": 4,
+                            "hidden_dims": [16]},
+            },
+            "backend": "distributed",
+            "distributed": {
+                "transport": "ipc",
+                "ipc_dir": str(tmp_path),
+                "round_duration_s": duration,
+                "startup_grace_s": 90.0,  # 5 spawns share one CI core
+            },
+            "faults": {"enabled": True, "seed": seed, **CHURN},
+        }
+    )
+    runner = DistributedRunner(cfg)
+    runner.start()
+    assert runner.injector is not None
+    history = runner.wait()
+
+    # The injector really killed and really respawned the scheduled victim.
+    kinds = {(kind, node) for _, kind, node in runner.injector.events}
+    assert ("kill", victim) in kinds, runner.injector.events
+    assert ("respawn", victim) in kinds, runner.injector.events
+
+    # Completed history, partial rounds recorded, no hang past a deadline:
+    # every round is present despite the mid-run SIGKILL.
+    assert history["round"] == list(range(1, ROUNDS + 1)), history
+    reporting = history["reporting_nodes"]
+    assert reporting[0] == NODES, history            # round 1 fully reported
+    assert reporting[1] == NODES - 1, history        # victim dead
+    assert reporting[2] == NODES - 1, history        # still dead
+    # Scheduled recovery: the node rejoined from its checkpoint and
+    # reports metrics again (skipped-frame or full — it is REPORTING).
+    assert reporting[3] == NODES, history
+    assert reporting[4] == NODES, history
+
+    # The per-node checkpoint the recovery restored from exists.
+    run_dirs = [p for p in tmp_path.iterdir() if p.is_dir()]
+    assert any(
+        (d / f"node_{victim}.ckpt.npz").exists() for d in run_dirs
+    ), list(tmp_path.rglob("*"))
+
+    # Learning survived the churn: the last real accuracy beats chance.
+    accs = np.asarray(history["mean_accuracy"], dtype=np.float64)
+    finite = accs[np.isfinite(accs)]
+    assert finite.size and finite[-1] > 0.3, history

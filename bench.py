@@ -221,9 +221,7 @@ def main():
     mfu = round(flops * rounds_per_sec / peak, 4) if flops and peak else None
 
     def emit(north_star, north_star_error):
-        print(
-            json.dumps(
-                {
+        payload = {
                     "metric": "fl_rounds_per_sec_krum_femnist_cnn_20node",
                     "value": round(rounds_per_sec, 3),
                     "unit": "rounds/sec",
@@ -258,10 +256,25 @@ def main():
                     "flops_per_round": flops,
                     "bytes_accessed_per_round": best["bytes_accessed"],
                     "mfu": mfu,
-                }
-            ),
-            flush=True,
-        )
+        }
+        # The stdout JSON line is the driver contract (last line wins) and
+        # stays; the SAME payload also lands as a kind:bench telemetry
+        # manifest (one schema for every artifact — docs/OBSERVABILITY.md).
+        # Each emit atomically replaces the manifest, mirroring the
+        # last-line-wins semantics; a manifest failure must not lose the
+        # printed headline.
+        print(json.dumps(payload), flush=True)
+        try:
+            from pathlib import Path
+
+            from murmura_tpu.telemetry.writer import write_bench_manifest
+
+            write_bench_manifest(
+                Path(__file__).parent / "telemetry_runs" / "bench",
+                "bench", payload,
+            )
+        except Exception as e:  # noqa: BLE001 — telemetry is best-effort here
+            print(f"bench: telemetry manifest write failed: {e}", flush=True)
 
     # The north-star SCALE scenario (BASELINE.json: 256-node Krum FEMNIST):
     # same flagship model at 256 nodes on this one chip, bf16 resident

@@ -255,9 +255,7 @@ def main():
         if SMOKE:
             blob["smoke"] = True
         out = f"bench_breakdown_{nodes}node.json"
-        Path(__file__).with_name(out).write_text(
-            json.dumps(blob, indent=2) + "\n"
-        )
+        _write_artifact(f"bench_breakdown_{nodes}node", blob, out)
         print(json.dumps(blob))
         return
 
@@ -315,12 +313,26 @@ def main():
     if SMOKE:
         blob["smoke"] = True
         out = "bench_breakdown_cpu_smoke.json"
+        name = "bench_breakdown_cpu_smoke"
     else:
         out = "bench_breakdown.json"
-    Path(__file__).with_name(out).write_text(
-        json.dumps(blob, indent=2) + "\n"
-    )
+        name = "bench_breakdown"
+    _write_artifact(name, blob, out)
     print(json.dumps(blob))
+
+
+def _write_artifact(name: str, blob: dict, legacy_name: str) -> None:
+    """Bench output through the one telemetry schema (docs/OBSERVABILITY.md):
+    the canonical artifact is a ``kind: bench`` manifest under
+    telemetry_runs/<name>/; the historical filename at the repo root stays
+    as a duplicated view of the same payload for one release."""
+    from murmura_tpu.telemetry.writer import write_bench_manifest
+
+    here = Path(__file__).parent
+    write_bench_manifest(
+        here / "telemetry_runs" / name, name, blob,
+        legacy_path=here / legacy_name,
+    )
 
 
 if __name__ == "__main__":

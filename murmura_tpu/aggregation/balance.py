@@ -84,6 +84,17 @@ def make_balance(
             neighbor_avg = circulant_masked_mean(bcast, accept_k, offsets)
             accepted_count = accept_k.sum(axis=0)
             degree = jnp.full((own.shape[0],), float(len(offsets)), own.dtype)
+            if ctx.audit:
+                # Sender-side taps via rolls only (ppermute-clean, MUR400):
+                # accept_k[o_idx, i] = receiver i accepted its neighbor at
+                # offsets[o_idx], i.e. sender (i + o) % n.
+                tap_selected_by = sum(
+                    jnp.roll(accept_k[i].astype(jnp.float32), o)
+                    for i, o in enumerate(offsets)
+                )
+                tap_considered_by = jnp.full(
+                    (own.shape[0],), float(len(offsets))
+                )
         else:
             dist = pairwise_l2_distances(own, bcast)
             accepted = accept_with_closest_fallback(
@@ -92,12 +103,21 @@ def make_balance(
             neighbor_avg = masked_neighbor_mean(bcast, accepted)
             accepted_count = accepted.sum(axis=1)
             degree = jnp.maximum(adj.sum(axis=1), 1.0)
+            if ctx.audit:
+                # Sender-side taps: column sums over the acceptance mask —
+                # the cross-shard reduction lowers to the all_reduce the
+                # dense inventory already declares (MUR400).
+                tap_selected_by = accepted.astype(jnp.float32).sum(axis=0)
+                tap_considered_by = adj.astype(jnp.float32).sum(axis=0)
 
         new_flat = blend_with_own(own, neighbor_avg, accepted_count > 0, alpha)
         stats = {
             "acceptance_rate": accepted_count / degree,
             "threshold": threshold,
         }
+        if ctx.audit:
+            stats["tap_selected_by"] = tap_selected_by
+            stats["tap_considered_by"] = tap_considered_by
         return new_flat, state, stats
 
     return AggregatorDef(

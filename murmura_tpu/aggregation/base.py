@@ -85,6 +85,14 @@ class AggContext:
     # lower to an all-gather; on ONE device the roles reverse (roll's
     # wrap-around slice pads up to 128x, a gather pads nothing).
     node_axis_sharded: bool = False
+    # telemetry.audit_taps: rules additionally surface per-node decision
+    # tensors (tap_* stats — who selected/accepted whom this round) riding
+    # the normal stats/history output path.  Trace-time static; the tapped
+    # program must add NO collectives beyond the rule's declared inventory
+    # (circulant taps use rolls, dense taps use axis reductions already in
+    # the declared set) and NO recompiles across rounds — both are
+    # machine-checked contracts (`murmura check --ir` MUR400/MUR402).
+    audit: bool = False
 
 
 @dataclass(frozen=True)

@@ -163,6 +163,19 @@ def make_evidential_trust(
             "mean_entropy": metrics["entropy"].mean(axis=0),
             "threshold": jnp.broadcast_to(current_threshold, (n,)),
         }
+        if ctx.audit:
+            # Sender-side taps via rolls only (ppermute stays the only
+            # roll-added collective — MUR400): trust[o_idx, i] is receiver
+            # i's trust of sender (i + o) % n.
+            stats["tap_selected_by"] = sum(
+                jnp.roll(accepted[i].astype(jnp.float32), o)
+                for i, o in enumerate(offsets)
+            )
+            stats["tap_considered_by"] = jnp.full((n,), float(k))
+            stats["tap_trust_received"] = sum(
+                jnp.roll(trust[i].astype(jnp.float32), o)
+                for i, o in enumerate(offsets)
+            ) / float(k)
         return new_flat, new_state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
@@ -243,6 +256,18 @@ def make_evidential_trust(
             "mean_entropy": masked(metrics["entropy"]),
             "threshold": jnp.broadcast_to(current_threshold, degree.shape),
         }
+        if ctx.audit:
+            # Receiver-side taps only on the dense path: the untapped dense
+            # evidential program lowers WITHOUT an all_reduce (its probe
+            # cross-eval is vmapped, not a Gram matmul), so a sender-side
+            # column sum would add a collective the untapped program does
+            # not have — exactly what MUR400 forbids (taps must observe,
+            # never communicate).  Row reductions are node-local.  The
+            # circulant path keeps the sender-side view (rolls are already
+            # its ppermutes); dense sender-side rejection analysis comes
+            # from krum/balance/ubar or the circulant exchange.
+            stats["tap_accepted"] = accepted.astype(jnp.float32).sum(axis=1)
+            stats["tap_considered"] = adj.astype(jnp.float32).sum(axis=1)
         return new_flat, new_state, stats
 
     return AggregatorDef(

@@ -113,6 +113,17 @@ def make_krum(
             "krum_score": best,
             "selected_own": selected_own.astype(jnp.float32),
         }
+        if ctx.audit:
+            # Sender-side audit taps via rolls only: accept_k[o_idx, i]
+            # says receiver i selected its neighbor at offsets[o_idx], so
+            # selected_by[s] = sum_o accept_k[o_idx, (s - o) % n] — each
+            # roll lowers to boundary ppermutes on a sharded node axis,
+            # keeping the circulant inventory ppermute-only (MUR400).
+            stats["tap_selected_by"] = sum(
+                jnp.roll(accept_k[i].astype(jnp.float32), o)
+                for i, o in enumerate(offsets)
+            )
+            stats["tap_considered_by"] = jnp.full((n,), float(len(offsets)))
         return new_flat, state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
@@ -162,6 +173,21 @@ def make_krum(
             "krum_score": best_scores,
             "selected_own": selected_own.astype(jnp.float32),
         }
+        if ctx.audit:
+            # Sender-side audit taps: how many peers picked node i's
+            # broadcast as their Krum winner (self-selections excluded),
+            # and how many had it as a candidate at all (its in-degree
+            # under the round's effective adjacency — faults included).
+            # ``murmura report`` turns considered - selected into the
+            # per-node rejection counts.  The column sums reduce across
+            # the sharded node axis, which lowers to the all_reduce the
+            # dense inventory already declares (MUR400).
+            node_ids = jnp.arange(n)
+            picked = (winners[:, None] == node_ids[None, :]) & (
+                ~selected_own[:, None]
+            )
+            stats["tap_selected_by"] = picked.astype(jnp.float32).sum(axis=0)
+            stats["tap_considered_by"] = adj.astype(jnp.float32).sum(axis=0)
         return new_flat, state, stats
 
     return AggregatorDef(

@@ -86,6 +86,14 @@ def make_ubar(
             "stage2_acceptance_rate": accepted.sum(axis=1) / shortlist_count,
             "own_loss": own_loss,
         }
+        if ctx.audit:
+            # Sender-side taps via rolls only (ppermute-clean, MUR400):
+            # accepted[i, o_idx] = receiver i accepted sender (i + o) % n.
+            stats["tap_selected_by"] = sum(
+                jnp.roll(accepted[:, i].astype(jnp.float32), o)
+                for i, o in enumerate(offsets)
+            )
+            stats["tap_considered_by"] = jnp.full((own.shape[0],), float(k))
         return new_flat, state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
@@ -132,6 +140,11 @@ def make_ubar(
             "stage2_acceptance_rate": accepted.sum(axis=1) / shortlist_count,
             "own_loss": own_loss,
         }
+        if ctx.audit:
+            # Sender-side taps: who passed the loss probe, per sender
+            # (column sums lower to the declared all_reduce — MUR400).
+            stats["tap_selected_by"] = accepted.astype(jnp.float32).sum(axis=0)
+            stats["tap_considered_by"] = adj.astype(jnp.float32).sum(axis=0)
         return new_flat, state, stats
 
     return AggregatorDef(

@@ -256,4 +256,34 @@ def check_contracts(tests_dir: Optional[Path] = None) -> List[Finding]:
                     f"ADDED edge weight at round {r} — fault masking may "
                     "only remove edges, never create or amplify them",
                 ))
+
+    # -- MUR401: telemetry schema version carries a migration note ----------
+    # The manifest schema is a cross-process, cross-release contract (old
+    # monitors read new node events; `murmura report` reads any past run
+    # dir).  A version bump without a written migration note strands every
+    # existing run directory, so the note is machine-required: bumping
+    # MANIFEST_SCHEMA_VERSION without adding "### v<N>" to the "Schema
+    # versions" section of docs/OBSERVABILITY.md fails `murmura check`.
+    tel_path = str(pkg / "telemetry" / "schema.py")
+    try:
+        from murmura_tpu.telemetry.schema import MANIFEST_SCHEMA_VERSION
+    except Exception as e:  # noqa: BLE001 — the import failure IS the finding
+        findings.append(Finding(
+            "MUR401", tel_path, 1,
+            f"telemetry.schema failed to import ({type(e).__name__}: {e}) "
+            "— the manifest schema-version contract cannot be checked",
+        ))
+        return findings
+    obs_doc = pkg.parent / "docs" / "OBSERVABILITY.md"
+    if obs_doc.is_file():  # source checkout only, like the MUR102 tests scan
+        text = obs_doc.read_text()
+        if f"### v{MANIFEST_SCHEMA_VERSION}" not in text:
+            findings.append(Finding(
+                "MUR401", tel_path, 1,
+                f"MANIFEST_SCHEMA_VERSION is {MANIFEST_SCHEMA_VERSION} but "
+                f"docs/OBSERVABILITY.md has no '### v"
+                f"{MANIFEST_SCHEMA_VERSION}' migration note under 'Schema "
+                "versions' — a schema bump must document how existing run "
+                "directories migrate",
+            ))
     return findings

@@ -216,6 +216,7 @@ def build_canonical(
     node_axis_sharded: bool = False,
     params: Optional[Dict[str, Any]] = None,
     dim: Optional[int] = None,
+    audit: bool = False,
 ) -> CanonicalProgram:
     """Instantiate one rule over one grid cell.
 
@@ -251,6 +252,7 @@ def build_canonical(
         total_rounds=10,
         num_classes=_PROBE_CLASSES,
         node_axis_sharded=node_axis_sharded,
+        audit=audit,
     )
 
     if name in _PROBE_RULES:
@@ -748,6 +750,154 @@ def check_fault_round() -> List[Finding]:
     return findings
 
 
+# Rules that surface per-node audit taps under telemetry.audit_taps
+# (tap_* stats).  MUR400/402 run over exactly this set; a new tapped rule
+# joins the contract by being added here.
+TAPPED_RULES: Tuple[str, ...] = ("krum", "balance", "ubar", "evidential_trust")
+
+
+def check_telemetry_taps() -> List[Finding]:
+    """MUR400/MUR402: the audit taps are IR-inert (docs/OBSERVABILITY.md).
+
+    The telemetry subsystem's core promise is that observing a round does
+    not change it.  Two machine-checked halves:
+
+    MUR400 — taps add zero collectives: each tapped rule's sharded-lowered
+    collective inventory with ``ctx.audit`` on equals the untapped
+    inventory (circulant taps are roll-assembled so they stay
+    ppermute-only; dense taps are axis reductions inside the already-
+    declared all_reduce).
+
+    MUR402 — tap recording toggles cause zero recompiles: a tapped round
+    program compiles once, and rounds that fetch the tap metrics
+    interleaved with rounds that ignore them reuse that executable
+    (CompileTracker, analysis/sanitizers.py) — recording is a host-side
+    decision, never a program change.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.analysis.sanitizers import RecompileError, track_compiles
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.base import FederatedArrays
+    from murmura_tpu.models import make_mlp
+
+    findings: List[Finding] = []
+    n_a = IR_NODE_COUNTS[0]
+
+    # -- MUR400 ------------------------------------------------------------
+    inventory_observable = True
+    for name in TAPPED_RULES:
+        for circulant in (False, True):
+            path, line = _rule_anchor(name)
+            try:
+                base = build_canonical(
+                    name, n_a, "float32", circulant, node_axis_sharded=True
+                )
+                tapped = build_canonical(
+                    name, n_a, "float32", circulant, node_axis_sharded=True,
+                    audit=True,
+                )
+                inv_base = collective_inventory(base)
+                if inv_base is None:
+                    inventory_observable = False
+                    break
+                inv_tap = collective_inventory(tapped)
+            except Exception as e:  # noqa: BLE001 — a crash IS the finding
+                findings.append(Finding(
+                    "MUR400", path, line,
+                    f"aggregator '{name}' ({_mode(circulant)}) crashed the "
+                    f"tapped inventory sweep: {type(e).__name__}: {e}",
+                ))
+                continue
+            stray = (inv_tap or frozenset()) - inv_base
+            if stray:
+                findings.append(Finding(
+                    "MUR400", path, line,
+                    f"aggregator '{name}' ({_mode(circulant)}) audit taps "
+                    f"lower to collective(s) {sorted(stray)} absent from "
+                    "the untapped program — observing a round must not add "
+                    "communication (assemble circulant taps from rolls, "
+                    "dense taps from declared-inventory reductions)",
+                ))
+        if not inventory_observable:
+            break
+    if not inventory_observable:
+        warnings.warn(
+            "murmura check --ir: fewer than 2 devices available — the "
+            "MUR400 tapped collective inventory is unobservable on this "
+            "platform (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+            stacklevel=2,
+        )
+
+    # -- MUR402 ------------------------------------------------------------
+    pkg = Path(__file__).resolve().parent.parent
+    anchor = str(pkg / "core" / "rounds.py")
+    n, s = 4, 16
+    rng = np.random.default_rng(0)
+    data = FederatedArrays(
+        x=rng.normal(size=(n, s, _PROBE_IN)).astype(np.float32),
+        y=rng.integers(0, _PROBE_CLASSES, size=(n, s)).astype(np.int32),
+        mask=np.ones((n, s), np.float32),
+        num_samples=np.full((n,), s),
+        num_classes=_PROBE_CLASSES,
+    )
+    model = make_mlp(
+        input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+    )
+    agg = build_aggregator(
+        "krum", dict(AGG_CASES["krum"]), model_dim=_probe_model()[2],
+        total_rounds=5,
+    )
+    tapped_prog = build_round_program(
+        model, agg, data, total_rounds=5, batch_size=8, audit_taps=True
+    )
+    adj = jnp.asarray(_canonical_adj(n, circulant=False))
+    d = {k: jnp.asarray(v) for k, v in tapped_prog.data_arrays.items()}
+    # One-shot analysis compile, not a hot path (the MUR204 pattern).
+    step = jax.jit(tapped_prog.train_step)  # murmura: ignore[MUR004]
+
+    def run_round(r: int, fetch_taps: bool):
+        out = step(
+            tapped_prog.init_params,
+            {k: jnp.asarray(v) for k, v in tapped_prog.init_agg_state.items()},
+            jax.random.PRNGKey(r),
+            adj,
+            jnp.zeros((n,), jnp.float32),
+            jnp.asarray(float(r), jnp.float32),
+            d,
+        )
+        params, _state, metrics = out
+        if fetch_taps:
+            # A recording round: the host fetches the per-node tap arrays.
+            jax.device_get(
+                {k: v for k, v in metrics.items() if k.startswith("agg_tap_")}
+            )
+        jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+
+    try:
+        with track_compiles() as tracker:
+            tracker.begin("warmup")
+            run_round(0, fetch_taps=True)
+            tracker.end(allow=True)
+            for r, fetch in ((1, False), (2, True), (3, False)):
+                tracker.begin(f"round {r} (record={fetch})")
+                run_round(r, fetch_taps=fetch)
+                tracker.end(allow=False)
+    except RecompileError as e:
+        findings.append(Finding(
+            "MUR402", anchor, 1,
+            f"toggling audit-tap recording across rounds recompiled the "
+            f"tapped round step ({e}) — tap recording must be a host-side "
+            "decision over a single compiled executable, never a program "
+            "change",
+        ))
+    return findings
+
+
 def check_coverage() -> List[Finding]:
     """MUR205: registry <-> canonical-case bijection (the MUR101
     counterpart that keeps every other MUR2xx rule non-vacuous)."""
@@ -857,6 +1007,15 @@ def check_ir(force: bool = False) -> List[Finding]:
         findings.append(Finding(
             "MUR302", str(pkg / "core" / "rounds.py"), 1,
             f"the fault-model IR contracts crashed: "
+            f"{type(e).__name__}: {e}",
+        ))
+    try:
+        findings.extend(check_telemetry_taps())
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        pkg = Path(__file__).resolve().parent.parent
+        findings.append(Finding(
+            "MUR400", str(pkg / "core" / "rounds.py"), 1,
+            f"the telemetry-tap IR contracts crashed: "
             f"{type(e).__name__}: {e}",
         ))
 

@@ -81,6 +81,11 @@ RULES: Dict[str, str] = {
     "MUR301": "fault-mask-zero-diagonal",
     "MUR302": "fault-mask-recompile",
     "MUR303": "fault-collective-inventory",
+    # 4xx = telemetry contracts (analysis/contracts.py + analysis/ir.py;
+    # docs/OBSERVABILITY.md)
+    "MUR400": "telemetry-tap-collectives",
+    "MUR401": "telemetry-schema-migration-note",
+    "MUR402": "telemetry-tap-recompile",
 }
 
 
@@ -106,6 +111,10 @@ STATIC_ATTRS = {
     "shape", "dtype", "ndim", "size", "itemsize", "nbytes",
     # AggContext static fields
     "evidential", "num_classes", "total_rounds", "node_axis_sharded",
+    # telemetry.audit_taps: a trace-time Python bool on AggContext — the
+    # tap branches are ordinary staging-time control flow (MUR400/402 pin
+    # that the tapped program is collective- and recompile-clean).
+    "audit",
 }
 
 # Callables whose function-position arguments execute under a trace, mapped

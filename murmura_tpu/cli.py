@@ -70,8 +70,13 @@ def app():
               help="Resume from --checkpoint-dir if a checkpoint exists")
 @click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
               help="Force the JAX platform (reference: cli.py:37 device override)")
+@click.option("--profile", "profile", is_flag=True, default=False,
+              help="Capture a profiler trace (perfetto/xprof) for the "
+                   "telemetry round window; with no telemetry.profile_rounds "
+                   "configured the whole run is captured. Implies telemetry "
+                   "(docs/OBSERVABILITY.md).")
 def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
-        resume, device):
+        resume, device, profile):
     """Run an experiment from a config file (reference: cli.py:34-60)."""
     if device is not None:
         # Must land before anything initializes the XLA backend.
@@ -81,6 +86,16 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
     config = _load_config_or_die(config_path)
     if verbose is not None:
         config.experiment.verbose = verbose
+    if profile:
+        if config.backend == "distributed":
+            raise click.UsageError(
+                "--profile captures a device trace of the jitted round "
+                "loop; backend: distributed trains on CPU worker "
+                "processes (use the telemetry counters instead)"
+            )
+        config.telemetry.enabled = True
+        if config.telemetry.profile_rounds == 0:
+            config.telemetry.profile_rounds = config.experiment.rounds
 
     console.print(
         f"[bold cyan]murmura_tpu[/bold cyan] experiment "
@@ -110,7 +125,7 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         )
 
         try:
-            network = build_network_from_config(config)
+            network = build_network_from_config(config, telemetry_resume=resume)
         except ConfigError as e:
             # Wiring-level config errors (data/model mismatch, unsupported
             # exchange mode, ...) — render the message, not the traceback.
@@ -143,6 +158,14 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         output.parent.mkdir(parents=True, exist_ok=True)
         output.write_text(json.dumps(history, indent=2))
         console.print(f"History written to [bold]{output}[/bold]")
+    if config.telemetry.enabled:
+        from murmura_tpu.utils.factories import default_telemetry_dir
+
+        console.print(
+            f"Telemetry run written to "
+            f"[bold]{default_telemetry_dir(config)}[/bold] — render it "
+            "with `murmura report <dir>`"
+        )
     return history
 
 
@@ -241,6 +264,39 @@ def check(paths, contracts, ir, as_json, update_budgets):
         )
         raise SystemExit(1)
     console.print("[bold green]murmura check: clean[/bold green]")
+
+
+@app.command()
+@click.argument(
+    "run_dir", type=click.Path(exists=True, file_okay=False, path_type=Path)
+)
+@click.option(
+    "--json", "as_json", is_flag=True, default=False,
+    help="Emit the report as one JSON object (machine-readable; the same "
+         "dict the tables render) instead of rich tables.",
+)
+def report(run_dir: Path, as_json: bool):
+    """Render a telemetry run directory (manifest.json + events.jsonl).
+
+    Works on any producer's output — a `murmura_tpu run` with
+    ``telemetry.enabled``, a distributed run's Monitor-folded manifest, or
+    a bench artifact (bench.py / bench_breakdown.py).  Sections: accuracy,
+    robustness/rule statistics, time breakdown by dispatch mode,
+    checkpoints, device memory, per-node audit taps (e.g. krum rejection
+    counts), distributed counters.  See docs/OBSERVABILITY.md.
+    """
+    from murmura_tpu.telemetry.report import build_report, render_report
+
+    try:
+        if as_json:
+            rep = build_report(run_dir)
+            rep.pop("manifest", None)  # the run dir already holds it
+            click.echo(json.dumps(rep))
+        else:
+            render_report(run_dir, console=console)
+    except FileNotFoundError as e:
+        console.print(f"[bold red]{escape(str(e))}[/bold red]")
+        raise SystemExit(1)
 
 
 @app.command("list-components")

@@ -183,6 +183,72 @@ class FaultsConfig(_Strict):
     )
 
 
+class TelemetryConfig(_Strict):
+    """Unified runtime telemetry (murmura_tpu extension; ISSUE 4 —
+    docs/OBSERVABILITY.md).
+
+    One versioned run manifest + JSONL event stream every backend emits
+    through (telemetry/writer.py), rendered by ``murmura report``.
+    Default off => byte-identical behavior to a config without this block:
+    the compiled round program, histories, and random streams are
+    untouched unless ``enabled`` is true.
+    """
+
+    enabled: bool = Field(default=False, description="Enable the telemetry run manifest")
+    dir: Optional[str] = Field(
+        default=None,
+        description=(
+            "Run directory for manifest.json + events.jsonl "
+            "(default: murmura_runs/<experiment.name>)"
+        ),
+    )
+    audit_taps: bool = Field(
+        default=False,
+        description=(
+            "In-jit aggregator audit taps: per-node decision tensors "
+            "(krum/ubar/balance acceptance masks, evidential trust scores, "
+            "quarantine/scrub flags) ride the round program's history "
+            "output as agg_tap_* arrays.  Guaranteed collective- and "
+            "recompile-clean (check --ir MUR400/MUR402)."
+        ),
+    )
+    phase_times: bool = Field(
+        default=True,
+        description=(
+            "Per-round phase_times events (per-round wall times; fused "
+            "dispatch records elapsed/k amortized per round — the "
+            "round_times semantics, now in one schema)"
+        ),
+    )
+    memory_stats: bool = Field(
+        default=False,
+        description=(
+            "Sample device memory_stats() into a per-round memory event "
+            "(no-op on platforms that expose none)"
+        ),
+    )
+    profile_dir: Optional[str] = Field(
+        default=None,
+        description=(
+            "Profiler trace output dir for the round-window capture "
+            "(default: <dir>/trace).  The whole-train trace remains "
+            "tpu.profile_dir."
+        ),
+    )
+    profile_start_round: int = Field(
+        default=0, ge=0,
+        description="First round of the profiler capture window",
+    )
+    profile_rounds: int = Field(
+        default=0, ge=0,
+        description=(
+            "Rounds to capture a perfetto/xprof trace for, starting at "
+            "profile_start_round (0 = no window capture; murmura run "
+            "--profile sets this to the whole run when unset)"
+        ),
+    )
+
+
 class TrainingConfig(_Strict):
     """Local training hyperparameters (reference: murmura/config/schema.py:142-150)."""
 
@@ -381,6 +447,30 @@ class Config(_Strict):
             "quarantine); default off => byte-identical to no faults block"
         ),
     )
+    telemetry: TelemetryConfig = Field(
+        default_factory=TelemetryConfig,
+        description=(
+            "Unified telemetry (run manifest + event stream + audit taps); "
+            "default off => byte-identical to no telemetry block"
+        ),
+    )
+
+    @model_validator(mode="after")
+    def _telemetry_requires_enabled(self):
+        t = self.telemetry
+        if not t.enabled and (
+            t.audit_taps or t.memory_stats or t.profile_rounds
+            or t.profile_start_round or t.dir is not None
+            or t.profile_dir is not None
+        ):
+            # A sub-feature without the master switch would silently record
+            # nothing — the experiment would *look* instrumented.  Fail loud.
+            raise ValueError(
+                "telemetry sub-settings (audit_taps/memory_stats/"
+                "profile_rounds/profile_start_round/profile_dir/dir) "
+                "require telemetry.enabled: true"
+            )
+        return self
 
     @model_validator(mode="after")
     def _faults_injection_in_range(self):

@@ -38,6 +38,7 @@ class Network:
         recompile_guard: bool = False,
         transfer_guard: bool = False,
         fault_schedule=None,
+        telemetry=None,
     ):
         self.program = program
         self.topology = topology
@@ -51,6 +52,19 @@ class Network:
         # program's alive argument — values only, no recompiles (the same
         # trick the compromised mask and mobility G^t already use).
         self.fault_schedule = fault_schedule
+        # Telemetry (telemetry/writer.py, docs/OBSERVABILITY.md): when a
+        # writer is attached, the round loops emit phase_times / round /
+        # memory / checkpoint events and each train() call re-finalizes
+        # the run manifest.  None (default) leaves every loop byte-for-byte
+        # on its pre-telemetry path — histories and compiled programs are
+        # identical (tested, tests/test_telemetry.py).
+        self.telemetry = telemetry
+        self._profile_window_active = False
+        # round_idx -> host in-degree of the round's effective adjacency,
+        # captured as a byproduct of the dispatch loop's own adjacency
+        # computation so _record's round events never re-run the mobility
+        # G^t / fault masking (O(N^2) host work) inside the timed window.
+        self._in_degree_cache: Dict[int, np.ndarray] = {}
         if fault_schedule is not None and not program.faulted:
             raise ValueError(
                 "A fault schedule was supplied but the round program was "
@@ -201,6 +215,8 @@ class Network:
             # folded host-side so the compiled program only ever sees a
             # differently-valued adjacency input.
             adj = self.fault_schedule.masked_adjacency(adj, round_idx)
+        if self.telemetry is not None:
+            self._in_degree_cache[round_idx] = np.asarray(adj).sum(axis=0)
         return adj
 
     def _alive_for_round(self, round_idx: int) -> np.ndarray:
@@ -302,7 +318,58 @@ class Network:
         finally:
             if profile:
                 jax.profiler.stop_trace()
+            # Close a still-open telemetry profile window (the run may end
+            # mid-window) and commit the manifest: each train() call
+            # re-finalizes, so the manifest is always the latest complete
+            # view even across checkpoint/resume segments.
+            self._profile_window_stop(self.current_round, force=True)
+            if self.telemetry is not None:
+                self.telemetry.finalize(history=self.history)
         return self.history
+
+    # ------------------------------------------------------------------
+    # telemetry hooks (telemetry/writer.py; docs/OBSERVABILITY.md)
+
+    def _profile_window_start(self, round_idx: int, span: int = 1) -> None:
+        """Open the telemetry profiler window at its scheduled round.
+
+        Skipped while the legacy whole-train trace (``tpu.profile_dir``)
+        is active — jax.profiler traces do not nest.  On the fused path
+        this is called at chunk boundaries with ``span`` = chunk size, so
+        the window opens at the first chunk OVERLAPPING it — a start round
+        strictly inside a chunk must not be skipped (the rounds
+        [round_idx, round_idx + span) dispatch as one program; containment
+        of round_idx alone would miss it).
+        """
+        t = self.telemetry
+        if (
+            t is None
+            or not t.profile_rounds
+            or self._profile_window_active
+            or self.profile_dir is not None
+        ):
+            return
+        end = t.profile_start_round + t.profile_rounds
+        if round_idx < end and round_idx + span > t.profile_start_round:
+            trace_dir = t.profile_dir or str(t.run_dir / "trace")
+            jax.profiler.start_trace(trace_dir)
+            self._profile_window_active = True
+            t.emit(
+                "profile", status="started", round=round_idx,
+                trace_dir=trace_dir,
+            )
+
+    def _profile_window_stop(self, next_round: int, force: bool = False) -> None:
+        t = self.telemetry
+        if t is None or not self._profile_window_active:
+            return
+        if force or next_round >= t.profile_start_round + t.profile_rounds:
+            jax.profiler.stop_trace()
+            self._profile_window_active = False
+            t.emit(
+                "profile", status="stopped", round=next_round - 1,
+                trace_dir=t.profile_dir or str(t.run_dir / "trace"),
+            )
 
     @contextlib.contextmanager
     def _sanitizer_scope(self):
@@ -360,6 +427,7 @@ class Network:
             k = min(chunk, rounds - done)
             step = self._fused_step(k, eval_every)
             round0 = self.current_round
+            self._profile_window_start(round0, span=k)
             t0 = time.perf_counter()
             program_key = ("fused", k, eval_every)
             if self._tracker is not None:
@@ -402,6 +470,17 @@ class Network:
             elapsed = time.perf_counter() - t0
             self.round_times.extend([elapsed / k] * k)
             done += k
+            if self.telemetry is not None:
+                # One amortized phase_times record per round — per-round
+                # wall times inside a single device dispatch are not
+                # observable, so the chunk's elapsed/k is the honest unit
+                # (same semantics as round_times; mode records the split).
+                for i in range(k):
+                    self.telemetry.phase_times(
+                        round0 + i, "fused", elapsed / k, chunk=k,
+                    )
+                self.telemetry.memory_event(self.current_round - 1)
+                self._profile_window_stop(self.current_round)
             for i in range(k):
                 if rows["evaluated"][i]:
                     self._record(
@@ -434,6 +513,7 @@ class Network:
         pending: List[Any] = []
         for _ in range(rounds):
             round_idx = self.current_round
+            self._profile_window_start(round_idx)
             t0 = time.perf_counter()
             warmup = "step" not in self._warmed
             if self._tracker is not None:
@@ -480,7 +560,16 @@ class Network:
                     self._record(self.current_round, metrics, verbose)
             if self._tracker is not None:
                 self._tracker.end(allow=warmup)
-            self.round_times.append(time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            self.round_times.append(wall)
+            if self.telemetry is not None:
+                self.telemetry.phase_times(
+                    round_idx, "per_round", wall,
+                    evaluated=bool(self.current_round % eval_every == 0),
+                    deferred=bool(defer_metrics),
+                )
+                self.telemetry.memory_event(round_idx)
+                self._profile_window_stop(self.current_round)
             if (
                 checkpoint_dir
                 and checkpoint_every
@@ -519,6 +608,7 @@ class Network:
         """Snapshot run state to ``directory`` (see utils/checkpoint.py)."""
         from murmura_tpu.utils.checkpoint import save_checkpoint
 
+        t0 = time.perf_counter()
         save_checkpoint(
             directory,
             params=self.params,
@@ -528,11 +618,17 @@ class Network:
             history=self.history,
             round_times=self.round_times,
         )
+        if self.telemetry is not None:
+            self.telemetry.checkpoint_event(
+                self.current_round, time.perf_counter() - t0,
+                action="save", path=str(directory),
+            )
 
     def restore_checkpoint(self, directory: str) -> int:
         """Restore run state; returns the round to continue from."""
         from murmura_tpu.utils.checkpoint import restore_checkpoint
 
+        t0 = time.perf_counter()
         params, agg_state, rng, round_num, history, times = restore_checkpoint(
             directory,
             params_target=self.params,
@@ -546,6 +642,11 @@ class Network:
         self.current_round = round_num
         self.history = history
         self.round_times = times
+        if self.telemetry is not None:
+            self.telemetry.checkpoint_event(
+                round_num, time.perf_counter() - t0,
+                action="restore", path=str(directory),
+            )
         return round_num
 
     def _record(self, round_num: int, metrics: Dict[str, np.ndarray], verbose: bool):
@@ -567,6 +668,31 @@ class Network:
                 float(np.asarray(metrics["strength"]).mean())
             )
 
+        if self.telemetry is not None:
+            # Per-node arrays of the recorded round (accuracy, agg_* rule
+            # stats, agg_tap_* audit taps) plus the host-side in-degree of
+            # the round's effective adjacency — the sender-side context
+            # `murmura report` turns tap counts into rejection counts
+            # with.  The in-degree was cached when the dispatch loop built
+            # the round's adjacency; the fallback recompute only fires for
+            # out-of-band _record calls (none today).
+            in_deg = self._in_degree_cache.pop(round_num - 1, None)
+            # Unrecorded rounds (eval_every > 1) never pop their entries;
+            # prune everything at or below the recorded round so the cache
+            # stays O(eval_every), not O(total rounds).
+            self._in_degree_cache = {
+                r: v for r, v in self._in_degree_cache.items()
+                if r >= round_num
+            }
+            if in_deg is None:
+                in_deg = np.asarray(
+                    self._adjacency_for_round(round_num - 1)
+                ).sum(axis=0)
+            self.telemetry.round_event(
+                round_num,
+                {k: np.asarray(v) for k, v in metrics.items()},
+                in_degree=in_deg,
+            )
         self._last_stats = {
             k[len("agg_"):]: np.asarray(v)
             for k, v in metrics.items()

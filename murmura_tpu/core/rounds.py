@@ -110,6 +110,7 @@ def build_round_program(
     param_dtype: Optional[str] = None,
     node_axis_sharded: bool = False,
     faults: Optional[FaultSpec] = None,
+    audit_taps: bool = False,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -132,6 +133,13 @@ def build_round_program(
             rolled back to the pre-round value); a node with zero alive
             neighbors degrades to self-model.  ``None`` (default) leaves
             the traced program byte-identical to pre-faults builds.
+        audit_taps: telemetry.audit_taps — aggregation rules surface
+            per-node decision tensors (``tap_*`` stats) and the fault
+            sentinel emits per-node quarantine/scrub/alive flags, all
+            riding the normal history-output path as ``agg_tap_*``
+            metrics.  Taps are collective- and recompile-clean by
+            contract (``murmura check --ir`` MUR400/MUR402); False
+            (default) leaves the traced program byte-identical.
     """
     n = data.num_nodes
     num_classes = data.num_classes or model.num_classes
@@ -301,6 +309,7 @@ def build_round_program(
         num_classes=num_classes,
         total_rounds=total_rounds,
         node_axis_sharded=node_axis_sharded,
+        audit=audit_taps,
     )
 
     attack_apply = attack.apply if attack is not None else None
@@ -335,7 +344,12 @@ def build_round_program(
             adj = adj * alive[:, None] * alive[None, :]
             train_mask = train_mask * alive
             pre_flat = jax.vmap(ravel)(params)
-        params = local_training(params, d, train_mask, train_key, round_idx)
+        # named_scope brackets label the `# murmura: traced` phases in
+        # profiler traces (xprof/perfetto op names) — metadata only, the
+        # lowered program is identical (the telemetry-off byte-identity
+        # contract, tests/test_telemetry.py).
+        with jax.named_scope("murmura.train"):
+            params = local_training(params, d, train_mask, train_key, round_idx)
 
         # 2. snapshot + attack on outgoing states (network.py:105-119)
         own_flat = jax.vmap(ravel)(params)
@@ -360,6 +374,13 @@ def build_round_program(
             fault_stats["quarantined"] = (
                 (1.0 - finite.astype(jnp.float32)) * alive_f
             ).sum()
+            if audit_taps:
+                # Per-node quarantine flags (telemetry.audit_taps): WHICH
+                # node diverged, not just how many — elementwise over
+                # node-local rows, so no collectives are added (MUR400).
+                fault_stats["tap_quarantined"] = (
+                    1.0 - finite.astype(jnp.float32)
+                ) * alive_f
             own_flat = jnp.where(finite[:, None], own_flat, pre_flat)
             fin = finite.astype(adj.dtype)
             adj = adj * fin[:, None] * fin[None, :]
@@ -368,9 +389,10 @@ def build_round_program(
         if attack_apply is not None:
             # Cast back: float32 attack noise must not promote the exchanged
             # [N, P] tensor when params are stored bfloat16 (tpu.param_dtype).
-            bcast = attack_apply(
-                own_flat, compromised, attack_key, round_idx
-            ).astype(own_flat.dtype)
+            with jax.named_scope("murmura.exchange"):
+                bcast = attack_apply(
+                    own_flat, compromised, attack_key, round_idx
+                ).astype(own_flat.dtype)
             if finite is not None:
                 # Second sentinel stage: the pre-training check cannot see
                 # an ATTACK that overflows to inf/NaN (huge noise_std,
@@ -387,6 +409,10 @@ def build_round_program(
                 fault_stats["attack_scrubbed"] = (
                     1.0 - bfin.astype(jnp.float32)
                 ).sum()
+                if audit_taps:
+                    fault_stats["tap_attack_scrubbed"] = 1.0 - bfin.astype(
+                        jnp.float32
+                    )
         else:
             bcast = own_flat
 
@@ -400,6 +426,7 @@ def build_round_program(
             num_classes=ctx.num_classes,
             total_rounds=ctx.total_rounds,
             node_axis_sharded=ctx.node_axis_sharded,
+            audit=ctx.audit,
         )
 
         # 2b. DMTT: claim exchange + trust update gate the exchange mask
@@ -429,9 +456,10 @@ def build_round_program(
 
         # 3. adjacency-masked aggregation (network.py:121-139)
         rule_state = {k: v for k, v in agg_state.items() if k not in DMTT_STATE_KEYS}
-        new_flat, rule_state, agg_stats = agg.aggregate(
-            own_flat, bcast, adj, round_idx, rule_state, step_ctx
-        )
+        with jax.named_scope("murmura.aggregate"):
+            new_flat, rule_state, agg_stats = agg.aggregate(
+                own_flat, bcast, adj, round_idx, rule_state, step_ctx
+            )
         agg_state = {**agg_state, **rule_state}
 
         if alive is not None:
@@ -448,6 +476,8 @@ def build_round_program(
                 keep = keep & finite
             new_flat = jnp.where(keep[:, None], new_flat, pre_flat)
             fault_stats["alive"] = alive.sum()
+            if audit_taps:
+                fault_stats["tap_alive"] = alive
         params = jax.vmap(unravel)(new_flat)
 
         metrics = {f"agg_{k}": v for k, v in agg_stats.items()}
@@ -469,7 +499,8 @@ def build_round_program(
     def eval_step(params, d):  # murmura: traced
         # evaluation (network.py:141-199) — held-out arrays when the data
         # loader provided them (eval_arrays), else the training shard.
-        return evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
+        with jax.named_scope("murmura.eval"):
+            return evaluate(params, d["eval_x"], d["eval_y"], d["eval_mask"])
 
     init_agg_state = {
         k: np.asarray(v) for k, v in agg.init_state(n).items()

@@ -15,6 +15,7 @@ import numpy as np
 from murmura_tpu.config.schema import Config
 from murmura_tpu.distributed.endpoints import Endpoints
 from murmura_tpu.distributed.messaging import MsgType, decode, unpack_obj
+from murmura_tpu.telemetry.schema import MONITOR_KNOWN_KEYS
 
 
 class Monitor:
@@ -26,6 +27,7 @@ class Monitor:
         compromised_ids: Optional[Set[int]] = None,
     ):
         self.config = config
+        self.run_id = run_id
         self.endpoints = Endpoints(config.distributed, run_id)
         self.t_start = t_start
         self.num_nodes = config.topology.num_nodes
@@ -46,9 +48,22 @@ class Monitor:
         }
         self._buffer: Dict[int, Dict[int, dict]] = {}
         self._flushed_through = -1
+        # Per-node CUMULATIVE operational counters (node_process.py emits
+        # the running totals on every frame; last frame wins), folded into
+        # the telemetry manifest at the end of the run.
+        self._node_counters: Dict[int, Dict[str, float]] = {}
+        # telemetry.enabled: the monitor owns the run manifest for the
+        # distributed backend (the same writer/schema the in-process
+        # orchestrator uses — telemetry/writer.py).  Built lazily in run()
+        # so construction stays socket- and filesystem-free for tests.
+        self._telemetry = None
 
     def run(self) -> Dict[str, List[Any]]:
         import zmq
+
+        from murmura_tpu.utils.factories import build_telemetry_writer
+
+        self._telemetry = build_telemetry_writer(self.config, run_id=self.run_id)
 
         ctx = zmq.Context()
         sock = ctx.socket(zmq.PULL)
@@ -73,7 +88,17 @@ class Monitor:
         finally:
             sock.close()
             ctx.term()
+            self._finalize_telemetry()
         return self.history
+
+    def _finalize_telemetry(self) -> None:
+        """Fold node counters + history into the one run manifest."""
+        if self._telemetry is None:
+            return
+        for counters in self._node_counters.values():
+            self._telemetry.add_counters(counters)
+        self._telemetry.finalize(history=self.history)
+        self._telemetry.close()
 
     # ------------------------------------------------------------------
 
@@ -82,6 +107,16 @@ class Monitor:
         n = int(metrics.get("node", -1))
         if r < 0 or r >= self.rounds or n < 0:
             return
+        # Cumulative counters are captured at ingest (last frame wins), so
+        # they survive even when the round itself never flushes — a node's
+        # final totals must not depend on its last round completing.
+        counters = metrics.get("counters")
+        if isinstance(counters, dict):
+            self._node_counters[n] = {
+                k: float(v)
+                for k, v in counters.items()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            }
         self._buffer.setdefault(r, {})[n] = metrics
 
     def _flush_complete(self) -> None:
@@ -127,6 +162,49 @@ class Monitor:
         # every partial-flushed round (the reference only logs the missing
         # set inside each node's stdout — node_process.py:259-269).
         self.history.setdefault("reporting_nodes", []).append(len(per_node))
+        # Forward-compat: metric keys this monitor version does not know
+        # (a newer node build, an experimental probe) are forwarded under
+        # extra.* — into the history AND the manifest event stream —
+        # instead of silently dropped (the pre-telemetry _ingest behavior;
+        # regression-tested in tests/test_distributed.py).  The union with
+        # already-recording extra.* lists keeps every such list appended on
+        # EVERY flushed round (None when nobody reported the key), so
+        # extra columns stay index-aligned with 'round' from the first
+        # round the key appears — including gap/all-skipped rounds.
+        extra_keys = sorted(
+            ({k for m in per_node.values() for k in m}
+             - set(MONITOR_KNOWN_KEYS))
+            | {
+                k[len("extra."):] for k in self.history
+                if k.startswith("extra.")
+            }
+        )
+        for k in extra_keys:
+            vals = {n: m[k] for n, m in per_node.items() if k in m}
+            nums = [
+                float(v) for v in vals.values()
+                if isinstance(v, (int, float)) and not isinstance(v, bool)
+            ]
+            self.history.setdefault(f"extra.{k}", []).append(
+                float(np.mean(nums)) if nums else None
+            )
+            if vals and self._telemetry is not None:
+                self._telemetry.emit(
+                    "extra", round=round_idx + 1, key=k,
+                    values={str(n): v for n, v in vals.items()},
+                )
+        if self._telemetry is not None:
+            self._telemetry.emit(
+                "round",
+                round=round_idx + 1,
+                nodes={
+                    str(n): {
+                        k: v for k, v in m.items()
+                        if k not in ("counters",)
+                    }
+                    for n, m in per_node.items()
+                },
+            )
         if not rows:
             # Every node overran its training window: keep the round visible
             # with NaN metrics instead of silently producing an empty

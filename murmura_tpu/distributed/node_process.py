@@ -85,6 +85,20 @@ class NodeProcess:
         self._pull = None
         self._push: Dict[int, object] = {}
         self._monitor_push = None
+        # Telemetry counters (docs/OBSERVABILITY.md): operational events
+        # that were previously only visible as per-process stdout lines.
+        # Ride every METRICS frame under the known 'counters' key; the
+        # Monitor folds them into the run manifest (a pre-telemetry
+        # monitor drops the unknown key harmlessly — forward-compat).
+        self._counters: Dict[str, float] = {
+            "send_retries": 0.0,
+            "send_failures": 0.0,
+            "reconnects": 0.0,
+            "rounds_skipped": 0.0,
+            "nonfinite_drops": 0.0,
+            "checkpoint_saves": 0.0,
+            "checkpoint_s": 0.0,
+        }
 
     # ------------------------------------------------------------------
 
@@ -429,6 +443,7 @@ class NodeProcess:
                 f"{sender}",
                 flush=True,
             )
+            self._counters["nonfinite_drops"] += 1
             return True
         return False
 
@@ -452,6 +467,8 @@ class NodeProcess:
                     f"(attempt {attempt + 1}/{attempts}): {e}",
                     flush=True,
                 )
+                self._counters["send_retries"] += 1
+                self._counters["reconnects"] += 1
                 sock = self._push.pop(neighbor_id, None)
                 if sock is not None:
                     try:
@@ -461,6 +478,7 @@ class NodeProcess:
                 if attempt + 1 < attempts:
                     time.sleep(delay)
                     delay *= 2
+        self._counters["send_failures"] += 1
         return False
 
     def _attacked_state(self, flat: np.ndarray, round_idx: int) -> np.ndarray:
@@ -620,6 +638,7 @@ class NodeProcess:
 
         from murmura_tpu.utils.checkpoint import durable_replace
 
+        t0 = time.monotonic()
         path = self.endpoints.node_checkpoint_path(self.node_id)
         os.makedirs(os.path.dirname(path), exist_ok=True)
         payload = {
@@ -634,6 +653,8 @@ class NodeProcess:
         durable_replace(
             os.path.dirname(path), os.path.basename(path), buf.getvalue()
         )
+        self._counters["checkpoint_saves"] += 1
+        self._counters["checkpoint_s"] += time.monotonic() - t0
 
     def _restore_node_checkpoint(self) -> Optional[int]:
         """Restore the last checkpoint; returns its round or None."""
@@ -664,10 +685,16 @@ class NodeProcess:
 
     def _send_metrics(self, round_idx: int, skipped: bool) -> None:
         metrics = {"round": round_idx, "node": self.node_id, "skipped": skipped}
-        if not skipped:
+        if skipped:
+            self._counters["rounds_skipped"] += 1
+        else:
             metrics.update(self.node.evaluate())
             metrics["stats"] = self.node.get_aggregator_statistics()
         metrics["compromised"] = self.is_compromised
+        # Cumulative operational counters ride every frame: the monitor
+        # folds the LAST value per node into the manifest, so losing any
+        # individual frame loses nothing (each frame carries the totals).
+        metrics["counters"] = dict(self._counters)
         try:
             self._monitor_push.send_multipart(
                 encode(MsgType.METRICS, self.node_id, pack_obj(metrics), round_idx)

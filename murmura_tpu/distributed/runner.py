@@ -252,4 +252,15 @@ class DistributedRunner:
             for p in self.node_procs:
                 if p.is_alive():
                     p.terminate()
+        if cfg.telemetry.enabled:
+            # The Monitor process owns the manifest (one writer per run);
+            # the runner only points the operator at it.
+            from murmura_tpu.utils.factories import default_telemetry_dir
+
+            print(
+                f"[runner] telemetry run written to "
+                f"{default_telemetry_dir(cfg)} — render with "
+                "`murmura report <dir>`",
+                flush=True,
+            )
         return history

@@ -293,12 +293,17 @@ class DMTTNodeProcess(NodeProcess):
 
     def _send_metrics(self, round_idx: int, skipped: bool) -> None:
         metrics = {"round": round_idx, "node": self.node_id, "skipped": skipped}
-        if not skipped:
+        if skipped:
+            self._counters["rounds_skipped"] += 1
+        else:
             metrics.update(self.node.evaluate())
             stats = self.node.get_aggregator_statistics()
             stats.update(self._dmtt_stats)
             metrics["stats"] = stats
         metrics["compromised"] = self.is_compromised
+        # Same cumulative counter stream as the base NodeProcess
+        # (docs/OBSERVABILITY.md) — the monitor folds the last totals.
+        metrics["counters"] = dict(self._counters)
         try:
             self._monitor_push.send_multipart(
                 encode(MsgType.METRICS, self.node_id, pack_obj(metrics), round_idx)

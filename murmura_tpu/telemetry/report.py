@@ -1,0 +1,279 @@
+"""``murmura report <run_dir>``: render a run manifest + event stream.
+
+Reads only the telemetry schema (schema.py) — any producer's run directory
+works: a CLI run, a Monitor-folded distributed run, or a bench artifact.
+Sections render only when their data exists, so a minimal manifest still
+produces a useful summary instead of a wall of empty tables.
+"""
+
+import math
+from typing import Any, Dict, List, Optional
+
+from murmura_tpu.telemetry.schema import KIND_BENCH
+from murmura_tpu.telemetry.writer import iter_events, read_manifest
+
+
+def _fmt(v: Any, nd: int = 4) -> str:
+    if isinstance(v, float):
+        if math.isnan(v):
+            return "nan"
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def _mean(xs: List[float]) -> float:
+    finite = [x for x in xs if isinstance(x, (int, float)) and math.isfinite(x)]
+    return sum(finite) / len(finite) if finite else float("nan")
+
+
+def build_report(run_dir) -> Dict[str, Any]:
+    """Machine-readable report dict (the renderer's single source; tests
+    assert on this instead of scraping table text)."""
+    manifest = read_manifest(run_dir)
+    if manifest is None:
+        raise FileNotFoundError(
+            f"no readable manifest.json under {run_dir} — not a telemetry "
+            "run directory (docs/OBSERVABILITY.md)"
+        )
+    events = list(iter_events(run_dir))
+    report: Dict[str, Any] = {"manifest": manifest, "run_dir": str(run_dir)}
+
+    history = manifest.get("history") or {}
+    if history.get("round"):
+        finite_acc = [
+            a for a in history["mean_accuracy"]
+            if isinstance(a, (int, float)) and math.isfinite(a)
+        ]
+        acc: Dict[str, Any] = {
+            "rounds_recorded": len(history["round"]),
+            "final_round": history["round"][-1],
+            "final_mean_accuracy": history["mean_accuracy"][-1],
+            # max over finite entries only: a partial-flush NaN row (an
+            # all-skipped distributed round) must not poison the best.
+            "best_mean_accuracy": max(finite_acc, default=float("nan")),
+            "final_mean_loss": history["mean_loss"][-1],
+        }
+        if history.get("honest_accuracy"):
+            acc["final_honest_accuracy"] = history["honest_accuracy"][-1]
+        if history.get("compromised_accuracy"):
+            acc["final_compromised_accuracy"] = history["compromised_accuracy"][-1]
+        report["accuracy"] = acc
+
+        robustness = {
+            k: {"mean": _mean(v), "last": v[-1] if v else None}
+            for k, v in history.items()
+            if k.startswith("agg_") and not k.startswith("agg_tap_")
+        }
+        for k in ("skipped_nodes", "reporting_nodes"):
+            if history.get(k):
+                robustness[k] = {"mean": _mean(history[k]), "last": history[k][-1]}
+        if robustness:
+            report["robustness"] = robustness
+
+    # ---- time breakdown -------------------------------------------------
+    phase = [e for e in events if e.get("type") == "phase_times"]
+    if phase:
+        by_mode: Dict[str, List[float]] = {}
+        for e in phase:
+            by_mode.setdefault(e.get("mode", "?"), []).append(e.get("wall_s", 0.0))
+        report["time"] = {
+            "rounds_timed": len(phase),
+            "total_s": sum(sum(v) for v in by_mode.values()),
+            "by_mode": {
+                m: {
+                    "rounds": len(v),
+                    "mean_s": _mean(v),
+                    "max_s": max(v),
+                }
+                for m, v in by_mode.items()
+            },
+        }
+    ckpt = [e for e in events if e.get("type") == "checkpoint"]
+    if ckpt:
+        saves = [e for e in ckpt if e.get("action") == "save"]
+        report["checkpoints"] = {
+            "saves": len(saves),
+            "restores": len(ckpt) - len(saves),
+            "total_save_s": sum(e.get("duration_s", 0.0) for e in saves),
+        }
+    mem = [
+        e for e in events
+        if e.get("type") == "memory" and isinstance(e.get("stats"), dict)
+    ]
+    if mem:
+        peaks = [
+            e["stats"].get("peak_bytes_in_use") or e["stats"].get("bytes_in_use")
+            for e in mem
+        ]
+        peaks = [p for p in peaks if isinstance(p, (int, float))]
+        if peaks:
+            report["memory"] = {
+                "samples": len(mem),
+                "peak_bytes_in_use": max(peaks),
+                "device_kind": mem[-1].get("device_kind"),
+            }
+    prof = [e for e in events if e.get("type") == "profile"]
+    if prof:
+        report["profile"] = prof
+
+    # ---- faults (per-node quarantine/alive from round events) -----------
+    rounds = [e for e in events if e.get("type") == "round"]
+    faults: Dict[str, Any] = {}
+    for key, out in (
+        ("agg_tap_quarantined", "quarantined_rounds"),
+        ("agg_tap_attack_scrubbed", "scrubbed_rounds"),
+        ("agg_tap_alive", "alive_rounds"),
+    ):
+        per_node = _per_node_sum(rounds, key)
+        if per_node is not None:
+            faults[out] = per_node
+    if faults:
+        report["faults"] = faults
+
+    # ---- audit taps: per-node acceptance/rejection ----------------------
+    taps = _tap_report(rounds)
+    if taps:
+        report["taps"] = taps
+
+    counters = manifest.get("counters") or {}
+    if counters:
+        report["counters"] = counters
+    if manifest.get("kind") == KIND_BENCH:
+        report["bench"] = manifest.get("summary") or {}
+    return report
+
+
+def _per_node_sum(rounds: List[dict], key: str) -> Optional[List[float]]:
+    rows = [
+        e["metrics"][key] for e in rounds
+        if isinstance(e.get("metrics"), dict)
+        and isinstance(e["metrics"].get(key), list)
+    ]
+    if not rows:
+        return None
+    n = max(len(r) for r in rows)
+    out = [0.0] * n
+    for r in rows:
+        for i, v in enumerate(r):
+            if isinstance(v, (int, float)) and math.isfinite(v):
+                out[i] += v
+    return out
+
+
+def _tap_report(rounds: List[dict]) -> Optional[Dict[str, Any]]:
+    """Per-node selection/rejection totals from the in-jit audit taps.
+
+    ``agg_tap_selected_by`` counts, per round, how many peers selected or
+    accepted node i's broadcast; ``agg_tap_considered_by`` how many peers
+    had it as a candidate (the round's effective in-degree under faults).
+    Rejections = considered - selected, summed over recorded rounds — the
+    "why did the Byzantine rule reject node 3" view (docs/OBSERVABILITY.md).
+    """
+    selected = _per_node_sum(rounds, "agg_tap_selected_by")
+    if selected is None:
+        return None
+    considered = _per_node_sum(rounds, "agg_tap_considered_by")
+    out: Dict[str, Any] = {"selected_by": selected}
+    if considered is not None:
+        out["considered_by"] = considered
+        out["rejections"] = [
+            max(0.0, c - s) for c, s in zip(considered, selected)
+        ]
+    return out
+
+
+# ----------------------------------------------------------------------
+# rendering
+
+
+def render_report(run_dir, console=None) -> Dict[str, Any]:
+    """Render the report with rich; returns the report dict."""
+    from rich.console import Console
+    from rich.table import Table
+
+    console = console or Console()
+    report = build_report(run_dir)
+    m = report["manifest"]
+    cfg = m.get("config") or {}
+    exp = cfg.get("experiment") or {}
+    console.print(
+        f"[bold cyan]murmura report[/bold cyan] — run "
+        f"[bold]{exp.get('name', m.get('run_id'))}[/bold] "
+        f"(kind={m.get('kind')}, schema=v{m.get('schema_version')}, "
+        f"run_id={m.get('run_id')}, "
+        f"{'finalized' if m.get('finalized') else 'IN PROGRESS'})"
+    )
+
+    def kv_table(title: str, mapping: Dict[str, Any]) -> None:
+        t = Table(title=title)
+        t.add_column("metric", style="cyan")
+        t.add_column("value", justify="right")
+        for k, v in mapping.items():
+            t.add_row(k, _fmt(v))
+        console.print(t)
+
+    if "accuracy" in report:
+        kv_table("Accuracy", report["accuracy"])
+    if "robustness" in report:
+        t = Table(title="Robustness / rule statistics (over recorded rounds)")
+        t.add_column("stat", style="cyan")
+        t.add_column("mean", justify="right")
+        t.add_column("last", justify="right")
+        for k, v in sorted(report["robustness"].items()):
+            t.add_row(k, _fmt(v["mean"]), _fmt(v["last"]))
+        console.print(t)
+    if "time" in report:
+        t = Table(title="Time breakdown")
+        t.add_column("dispatch mode", style="cyan")
+        t.add_column("rounds", justify="right")
+        t.add_column("mean s/round", justify="right")
+        t.add_column("max s", justify="right")
+        for mode, v in report["time"]["by_mode"].items():
+            t.add_row(mode, str(v["rounds"]), _fmt(v["mean_s"]), _fmt(v["max_s"]))
+        console.print(t)
+        console.print(
+            f"  total timed: {_fmt(report['time']['total_s'], 2)}s over "
+            f"{report['time']['rounds_timed']} round records"
+        )
+    if "checkpoints" in report:
+        kv_table("Checkpoints", report["checkpoints"])
+    if "memory" in report:
+        kv_table("Device memory", report["memory"])
+    if "taps" in report or "faults" in report:
+        taps = report.get("taps") or {}
+        faults = report.get("faults") or {}
+        n = max(
+            [len(v) for v in taps.values()] + [len(v) for v in faults.values()]
+        )
+        t = Table(title="Per-node audit (totals over recorded rounds)")
+        t.add_column("node", justify="right")
+        cols = []
+        for key, src in (
+            ("selected_by", taps), ("considered_by", taps),
+            ("rejections", taps), ("quarantined_rounds", faults),
+            ("scrubbed_rounds", faults), ("alive_rounds", faults),
+        ):
+            if key in src:
+                t.add_column(key, justify="right")
+                cols.append(src[key])
+        for i in range(n):
+            t.add_row(
+                str(i), *[_fmt(c[i], 1) if i < len(c) else "-" for c in cols]
+            )
+        console.print(t)
+    if "counters" in report:
+        kv_table("Distributed counters", report["counters"])
+    if "bench" in report:
+        flat = {
+            k: v for k, v in report["bench"].items()
+            if isinstance(v, (int, float, str)) or v is None
+        }
+        kv_table("Bench summary", {k: "null" if v is None else v for k, v in flat.items()})
+    extra = [e for e in iter_events(run_dir) if e.get("type") == "extra"]
+    if extra:
+        console.print(
+            f"[yellow]{len(extra)} forward-compat 'extra' event(s) — keys "
+            "this version does not understand were preserved, not "
+            "dropped[/yellow]"
+        )
+    return report

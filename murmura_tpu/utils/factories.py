@@ -191,6 +191,49 @@ def build_fault_schedule(config: Config):
     )
 
 
+def default_telemetry_dir(config: Config) -> str:
+    """The run directory a telemetry-enabled config writes to when
+    ``telemetry.dir`` is unset — shared by every consumer (Network wiring,
+    the Monitor process, the CLI's report hint) so they agree on one path."""
+    import os
+
+    return config.telemetry.dir or os.path.join(
+        "murmura_runs", config.experiment.name
+    )
+
+
+def build_telemetry_writer(
+    config: Config, kind: str = "run", run_id=None, resume: bool = False
+):
+    """TelemetryWriter from config.telemetry, or None when off.
+
+    The single construction path for every consumer (the simulation/tpu
+    orchestrator and the ZMQ Monitor process), so the manifest schema and
+    run-dir resolution cannot drift between backends.  ``resume`` marks an
+    intentional continuation (checkpoint restore) — the event stream
+    appends; a fresh run into the same dir rotates the stale stream
+    instead (writer.py).
+    """
+    t = config.telemetry
+    if not t.enabled:
+        return None
+    from murmura_tpu.telemetry.writer import TelemetryWriter
+
+    return TelemetryWriter(
+        default_telemetry_dir(config),
+        kind=kind,
+        run_id=run_id,
+        config=config,
+        record_taps=True,
+        phase_times=t.phase_times,
+        memory_stats=t.memory_stats,
+        profile_dir=t.profile_dir,
+        profile_start_round=t.profile_start_round,
+        profile_rounds=t.profile_rounds,
+        resume=resume,
+    )
+
+
 def build_fault_spec(config: Config):
     """Trace-time FaultSpec from config.faults, or None when off."""
     f = config.faults
@@ -309,8 +352,15 @@ def _node_axis_sharded(config: Config, mesh=None) -> bool:
     return jax.device_count() > 1
 
 
-def build_network_from_config(config: Config, mesh=None) -> Network:
-    """Full wiring: data + model + aggregator + attack -> Network."""
+def build_network_from_config(
+    config: Config, mesh=None, telemetry_resume: bool = False
+) -> Network:
+    """Full wiring: data + model + aggregator + attack -> Network.
+
+    ``telemetry_resume``: this Network will continue a prior run (the CLI
+    --resume path) — its telemetry appends to the run dir's existing event
+    stream instead of rotating it.
+    """
     if config.backend == "tpu" and config.tpu.multihost and mesh is None:
         # Must run before ANY jax call that initializes the XLA backend
         # (the eval_shape below would); jax.distributed.initialize refuses
@@ -437,6 +487,7 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         param_dtype=resolved_param_dtype(config),
         node_axis_sharded=_node_axis_sharded(config, mesh),
         faults=build_fault_spec(config),
+        audit_taps=config.telemetry.audit_taps,
     )
 
     if config.backend == "tpu" and mesh is None:
@@ -457,4 +508,5 @@ def build_network_from_config(config: Config, mesh=None) -> Network:
         recompile_guard=config.tpu.recompile_guard,
         transfer_guard=config.tpu.transfer_guard,
         fault_schedule=build_fault_schedule(config),
+        telemetry=build_telemetry_writer(config, resume=telemetry_resume),
     )

@@ -10,7 +10,14 @@
 # they actually ran (breakdown always writes; check "backend" in the JSON).
 set -u
 CHAOS=0
-if [ "${1:-}" = "--chaos" ]; then CHAOS=1; shift; fi
+PROFILE=0
+while :; do
+  case "${1:-}" in
+    --chaos) CHAOS=1; shift;;
+    --profile) PROFILE=1; shift;;
+    *) break;;
+  esac
+done
 OUT="${1:-/root/repo/tpu_battery_results}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")"
@@ -54,6 +61,55 @@ if [ "$CHAOS" = 1 ]; then
     exit 1
   fi
   echo "preflight chaos smoke clean" | tee -a "$OUT/battery.log"
+fi
+# Optional profiling pre-flight (./run_tpu_battery.sh --profile [outdir]):
+# a tiny CPU-pinned run with the telemetry profiler window armed must
+# produce a non-empty trace capture (docs/OBSERVABILITY.md) — if trace
+# plumbing is broken, find out before a chip session depends on it.
+if [ "$PROFILE" = 1 ]; then
+  echo "=== preflight: telemetry profile capture ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  PROF_RUN="$OUT/profile_preflight"
+  rm -rf "$PROF_RUN"
+  if ! timeout 600 env JAX_PLATFORMS=cpu MURMURA_TELEMETRY_DIR="$PROF_RUN" python - > "$OUT/preflight_profile.out" 2>&1 <<'PYEOF'
+import os, sys
+from pathlib import Path
+import yaml
+cfg = yaml.safe_load(Path("examples/configs/telemetry_audit_report.yaml").read_text())
+cfg["experiment"]["rounds"] = 3
+cfg["telemetry"]["dir"] = os.environ["MURMURA_TELEMETRY_DIR"]
+cfg["telemetry"]["profile_rounds"] = 2
+cfg["telemetry"]["profile_start_round"] = 1
+tmp = Path(os.environ["MURMURA_TELEMETRY_DIR"] + ".yaml")
+tmp.parent.mkdir(parents=True, exist_ok=True)
+tmp.write_text(yaml.safe_dump(cfg))
+from click.testing import CliRunner
+from murmura_tpu.cli import app
+r = CliRunner().invoke(app, ["run", str(tmp), "--quiet"])
+print(r.output)
+if r.exit_code:
+    sys.exit(r.exit_code)
+run_dir = Path(os.environ["MURMURA_TELEMETRY_DIR"])
+trace = run_dir / "trace"
+captured = list(trace.rglob("*")) if trace.is_dir() else []
+if not any(p.is_file() and p.stat().st_size > 0 for p in captured):
+    print(f"no non-empty trace files under {trace}")
+    sys.exit(1)
+import json
+events = [json.loads(l) for l in (run_dir / "events.jsonl").read_text().splitlines()]
+prof = [e for e in events if e.get("type") == "profile"]
+if not any(e.get("status") == "started" for e in prof) or not any(
+    e.get("status") == "stopped" for e in prof
+):
+    print(f"profile window events incomplete: {prof}")
+    sys.exit(1)
+print(f"trace capture ok: {sum(1 for p in captured if p.is_file())} file(s)")
+PYEOF
+  then
+    echo "preflight profile capture FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_profile.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight profile capture clean" | tee -a "$OUT/battery.log"
 fi
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py

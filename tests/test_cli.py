@@ -128,3 +128,42 @@ def test_check_package_is_clean():
     pkg = str(Path(murmura_tpu.__file__).resolve().parent)
     result = CliRunner().invoke(app, ["check", pkg])
     assert result.exit_code == 0, result.output
+
+
+def test_run_with_telemetry_then_report_smoke(tmp_path):
+    """Tier-1 `murmura report` smoke (ISSUE 4 satellite): a telemetry run
+    renders end-to-end, and --json exposes the same report dict."""
+    run_dir = tmp_path / "run"
+    cfg = _write_cfg(
+        tmp_path,
+        aggregation={"algorithm": "krum", "params": {"num_compromised": 1}},
+        telemetry={"enabled": True, "dir": str(run_dir), "audit_taps": True},
+    )
+    result = CliRunner().invoke(app, ["run", str(cfg)])
+    assert result.exit_code == 0, result.output
+    assert "Telemetry run written" in result.output
+
+    rendered = CliRunner().invoke(app, ["report", str(run_dir)])
+    assert rendered.exit_code == 0, rendered.output
+    assert "murmura report" in rendered.output
+    assert "Accuracy" in rendered.output
+
+    as_json = CliRunner().invoke(app, ["report", str(run_dir), "--json"])
+    assert as_json.exit_code == 0, as_json.output
+    rep = json.loads(as_json.output)
+    assert rep["accuracy"]["rounds_recorded"] == 2
+    assert len(rep["taps"]["selected_by"]) == 4
+    assert rep["time"]["by_mode"]["per_round"]["rounds"] == 2
+
+
+def test_report_rejects_non_run_dir(tmp_path):
+    result = CliRunner().invoke(app, ["report", str(tmp_path)])
+    assert result.exit_code == 1
+    assert "manifest" in result.output
+
+
+def test_run_profile_flag_rejected_on_distributed(tmp_path):
+    cfg = _write_cfg(tmp_path, backend="distributed")
+    result = CliRunner().invoke(app, ["run", str(cfg), "--profile"])
+    assert result.exit_code != 0
+    assert "--profile" in result.output

@@ -573,3 +573,105 @@ class TestMonitorFlush:
         assert mon.history["skipped_nodes"] == [2]
         assert mon.history["reporting_nodes"] == [2]
         assert np.isnan(mon.history["mean_accuracy"][0])
+
+
+class TestMonitorTelemetry:
+    """Telemetry leg of the Monitor (docs/OBSERVABILITY.md): unknown-key
+    forward-compat (the _ingest silent-drop fix), cumulative counter
+    capture, and manifest folding — all socketless."""
+
+    def _monitor(self, tmp_path=None, nodes=2, rounds=2):
+        from murmura_tpu.distributed.monitor import Monitor
+
+        raw = {
+            "experiment": {"name": "mtel", "seed": 0, "rounds": rounds},
+            "topology": {"type": "ring", "num_nodes": nodes},
+            "aggregation": {"algorithm": "fedavg"},
+            "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.1},
+            "data": {"adapter": "synthetic",
+                     "params": {"num_samples": 64, "input_dim": 4,
+                                "num_classes": 2}},
+            "model": {"factory": "mlp",
+                      "params": {"input_dim": 4, "hidden_dims": [4],
+                                 "num_classes": 2}},
+            "backend": "distributed",
+            "distributed": {"transport": "ipc"},
+        }
+        if tmp_path is not None:
+            raw["telemetry"] = {"enabled": True, "dir": str(tmp_path / "run")}
+        return Monitor(Config.model_validate(raw), "test", t_start=0.0)
+
+    def test_unknown_metric_keys_forwarded_under_extra(self):
+        """Forward-compat regression (ISSUE 4 satellite): an OLD monitor
+        reading NEW node events must preserve keys it does not understand
+        under extra.* instead of silently dropping them — the historical
+        _ingest behavior lost them entirely."""
+        mon = self._monitor()
+        for node in range(2):
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                         "loss": 1.0, "future_metric": 2.0 + node,
+                         "future_blob": "opaque"})
+        mon._flush_complete()
+        assert mon.history["round"] == [1]
+        # Numeric unknowns: mean over reporting nodes, index-aligned.
+        assert mon.history["extra.future_metric"] == [pytest.approx(2.5)]
+        # Non-numeric unknowns still get a placeholder row (not dropped).
+        assert mon.history["extra.future_blob"] == [None]
+        # Known keys are NOT duplicated under extra.
+        assert "extra.accuracy" not in mon.history
+
+    def test_cumulative_counters_captured_at_ingest(self):
+        """Counters are running totals captured at ingest (last frame
+        wins), so they survive rounds that never flush."""
+        mon = self._monitor()
+        mon._ingest({"round": 0, "node": 0, "accuracy": 0.5, "loss": 1.0,
+                     "counters": {"send_retries": 1.0, "checkpoint_s": 0.2}})
+        mon._ingest({"round": 1, "node": 0, "accuracy": 0.6, "loss": 0.9,
+                     "counters": {"send_retries": 3.0, "checkpoint_s": 0.5}})
+        # Round 1 never completes (node 1 silent) — totals must survive.
+        assert mon._node_counters[0]["send_retries"] == 3.0
+        assert mon._node_counters[0]["checkpoint_s"] == 0.5
+
+    def test_counters_and_history_fold_into_manifest(self, tmp_path):
+        from murmura_tpu.telemetry.writer import (
+            events_of_type,
+            read_manifest,
+        )
+        from murmura_tpu.utils.factories import build_telemetry_writer
+
+        mon = self._monitor(tmp_path)
+        mon._telemetry = build_telemetry_writer(mon.config, run_id="test")
+        for node in range(2):
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                         "loss": 1.0, "future_metric": 7.0,
+                         "counters": {"send_retries": float(node)}})
+        mon._flush_complete()
+        mon._finalize_telemetry()
+        run = tmp_path / "run"
+        m = read_manifest(run)
+        assert m["finalized"] is True
+        assert m["run_id"] == "test"
+        # Per-node cumulative totals summed across the fleet.
+        assert m["counters"]["send_retries"] == 1.0
+        assert m["history"]["round"] == [1]
+        rounds = events_of_type(run, "round")
+        assert rounds and set(rounds[0]["nodes"]) == {"0", "1"}
+        extras = events_of_type(run, "extra")
+        assert extras and extras[0]["key"] == "future_metric"
+
+    def test_extra_lists_stay_aligned_across_gap_rounds(self):
+        """extra.* columns must stay index-aligned with history['round']:
+        rounds where nobody reports the key (including wholly-unreported
+        gap rounds) get a None placeholder, not a silent skip."""
+        mon = self._monitor(nodes=2, rounds=3)
+        for node in range(2):
+            mon._ingest({"round": 0, "node": node, "accuracy": 0.5,
+                         "loss": 1.0, "future_metric": 4.0})
+        # Round 1: zero messages (gap). Round 2: reported WITHOUT the key.
+        for node in range(2):
+            mon._ingest({"round": 2, "node": node, "accuracy": 0.6,
+                         "loss": 0.9})
+        mon._flush_complete()
+        mon._flush_partial()
+        assert mon.history["round"] == [1, 2, 3]
+        assert mon.history["extra.future_metric"] == [4.0, None, None]

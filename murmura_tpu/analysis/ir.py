@@ -750,6 +750,184 @@ def check_fault_round() -> List[Finding]:
     return findings
 
 
+def check_gang_round() -> List[Finding]:
+    """MUR500/MUR501: gang batching (core/gang.py) is IR-inert.
+
+    The gang subsystem's core promise (docs/PERFORMANCE.md) is that
+    stacking S experiments and vmapping the round program over the seed
+    axis changes neither the program's communication nor its compile
+    stability.  Two machine-checked halves:
+
+    MUR500 — vmap adds zero collectives, in two sharded lowerings: on the
+    node axis the gang program's collective inventory equals the single
+    run's (same exchange, batched); on the seed axis ALONE it must be
+    collective-FREE — members are independent experiments, so any
+    seed-axis collective means a rule accidentally reduced across
+    members.
+
+    MUR501 — growing S within a bucket causes zero recompiles: the gang
+    pads to power-of-two buckets (core.gang.next_bucket), so a padded
+    S=2 gang and a padded S=3 gang present identical shapes and must reuse
+    one compiled executable (CompileTracker, analysis/sanitizers.py).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.analysis.sanitizers import RecompileError, track_compiles
+    from murmura_tpu.core import gang as gang_mod
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.base import FederatedArrays
+    from murmura_tpu.models import make_mlp
+
+    pkg = Path(__file__).resolve().parent.parent
+    anchor = str(pkg / "core" / "gang.py")
+    findings: List[Finding] = []
+
+    n, s = 4, 16
+    rng = np.random.default_rng(0)
+    data = FederatedArrays(
+        x=rng.normal(size=(n, s, _PROBE_IN)).astype(np.float32),
+        y=rng.integers(0, _PROBE_CLASSES, size=(n, s)).astype(np.int32),
+        mask=np.ones((n, s), np.float32),
+        num_samples=np.full((n,), s),
+        num_classes=_PROBE_CLASSES,
+    )
+    model = make_mlp(
+        input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+    )
+    agg = build_aggregator(
+        "fedavg", {}, model_dim=_probe_model()[2], total_rounds=5
+    )
+    prog = build_round_program(model, agg, data, total_rounds=5, batch_size=8)
+    adj = jnp.asarray(_canonical_adj(n, circulant=False))
+    d = {k: jnp.asarray(v) for k, v in prog.data_arrays.items()}
+    gang_axes = (0, 0, 0, None, 0, None, 0)
+    vstep = jax.vmap(prog.train_step, in_axes=gang_axes)
+
+    def gang_args(batch: int, live: int):
+        """Stacked gang inputs for ``live`` members padded to ``batch``
+        (the core.gang padding: tail slots replicate member 0)."""
+        idx = list(range(live)) + [0] * (batch - live)
+        stack = lambda t: jax.tree_util.tree_map(  # noqa: E731
+            lambda l: jnp.stack([l] * batch), t
+        )
+        return (
+            stack(prog.init_params),
+            stack({k: jnp.asarray(v) for k, v in prog.init_agg_state.items()}),
+            jnp.stack([jax.random.PRNGKey(i) for i in idx]),
+            adj,
+            jnp.zeros((batch, n), jnp.float32),
+            jnp.asarray(0.0, jnp.float32),
+            stack(d),
+        )
+
+    # -- MUR501 ------------------------------------------------------------
+    # One-shot analysis compile, not a hot path (the MUR204 pattern).
+    # S=3 and S=4 share the power-of-two bucket (next_bucket -> 4), so the
+    # padded shapes are identical and the second gang must be a cache hit
+    # — the bucket mapping itself is the contract under test (resolved via
+    # the gang module so a broken implementation is observable).
+    step = jax.jit(vstep)  # murmura: ignore[MUR004]
+    try:
+        with track_compiles() as tracker:
+            tracker.begin("gang warmup (S=3)")
+            jax.block_until_ready(
+                step(*gang_args(gang_mod.next_bucket(3), 3))[0]
+            )
+            tracker.end(allow=True)
+            tracker.begin("gang grown to S=4 (same bucket)")
+            jax.block_until_ready(
+                step(*gang_args(gang_mod.next_bucket(4), 4))[0]
+            )
+            tracker.end(allow=False)
+    except RecompileError as e:
+        findings.append(Finding(
+            "MUR501", anchor, 1,
+            f"growing the gang within a bucket recompiled the gang round "
+            f"step ({e}) — bucket padding must make member count a pure "
+            "input-value change (core.gang.next_bucket)",
+        ))
+
+    # -- MUR500 ------------------------------------------------------------
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from murmura_tpu.parallel import mesh as mesh_mod
+
+    devices = jax.devices()
+    usable = [c for c in (2, 4) if c <= len(devices) and n % c == 0]
+    if not usable:
+        warnings.warn(
+            "murmura check --ir: fewer than 2 devices available — the "
+            "MUR500 gang collective inventory is unobservable on this "
+            "platform (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+            stacklevel=2,
+        )
+        return findings
+    n_shards = max(usable)
+    single_mesh = Mesh(np.array(devices[:n_shards]), ("nodes",))
+    node_s = NamedSharding(single_mesh, P("nodes"))
+
+    def single_inventory():
+        sharded = mesh_mod._shard_round_fn(
+            prog.train_step, prog, single_mesh, node_s, donate=False,
+            alive_sharding=node_s,
+        )
+        args = (
+            prog.init_params,
+            {k: jnp.asarray(v) for k, v in prog.init_agg_state.items()},
+            jax.random.PRNGKey(0),
+            adj,
+            jnp.zeros((n,), jnp.float32),
+            jnp.asarray(0.0, jnp.float32),
+            d,
+        )
+        txt = sharded.lower(*args).compile().as_text()
+        return frozenset(_HLO_COLLECTIVES[m] for m in _COLL_RE.findall(txt))
+
+    def gang_inventory(batch: int, seed_ax: int, node_ax: int):
+        gang_mesh = Mesh(
+            np.array(devices[: seed_ax * node_ax]).reshape(seed_ax, node_ax),
+            ("seed", "nodes"),
+        )
+        sharded = mesh_mod.shard_gang_step(
+            vstep, prog, batch, gang_mesh, donate=False
+        )
+        txt = sharded.lower(*gang_args(batch, batch)).compile().as_text()
+        return frozenset(_HLO_COLLECTIVES[m] for m in _COLL_RE.findall(txt))
+
+    # Half 1 — node-axis inventory equality: vmapping over the seed axis
+    # must not change which collectives the node-sharded exchange lowers
+    # to (same kinds as the single run on the same node mesh).
+    stray = gang_inventory(2, 1, n_shards) - single_inventory()
+    if stray:
+        findings.append(Finding(
+            "MUR500", anchor, 1,
+            f"the vmapped gang round step lowers to collective(s) "
+            f"{sorted(stray)} absent from the single-run round — vmap over "
+            "the experiment axis must not change the node exchange's "
+            "communication",
+        ))
+    # Half 2 — seed-axis isolation: sharded along the seed axis ALONE
+    # (node axis unsharded), the gang program must lower to ZERO
+    # collectives.  The experiment axis is embarrassingly parallel by
+    # construction; any collective here is cross-member communication — a
+    # rule accidentally reducing across gang members.
+    cross_member = gang_inventory(2, 2, 1)
+    if cross_member:
+        findings.append(Finding(
+            "MUR500", anchor, 1,
+            f"the gang round step sharded along the seed axis alone "
+            f"lowers to collective(s) {sorted(cross_member)} — members are "
+            "independent experiments and may never communicate; a "
+            "collective on the seed axis means something reduced across "
+            "gang members",
+        ))
+    return findings
+
+
 # Rules that surface per-node audit taps under telemetry.audit_taps
 # (tap_* stats).  MUR400/402 run over exactly this set; a new tapped rule
 # joins the contract by being added here.
@@ -1016,6 +1194,15 @@ def check_ir(force: bool = False) -> List[Finding]:
         findings.append(Finding(
             "MUR400", str(pkg / "core" / "rounds.py"), 1,
             f"the telemetry-tap IR contracts crashed: "
+            f"{type(e).__name__}: {e}",
+        ))
+    try:
+        findings.extend(check_gang_round())
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        pkg = Path(__file__).resolve().parent.parent
+        findings.append(Finding(
+            "MUR500", str(pkg / "core" / "gang.py"), 1,
+            f"the gang-batching IR contracts crashed: "
             f"{type(e).__name__}: {e}",
         ))
 

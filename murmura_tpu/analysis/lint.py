@@ -86,6 +86,10 @@ RULES: Dict[str, str] = {
     "MUR400": "telemetry-tap-collectives",
     "MUR401": "telemetry-schema-migration-note",
     "MUR402": "telemetry-tap-recompile",
+    # 5xx = gang-batched execution contracts (analysis/ir.py;
+    # docs/PERFORMANCE.md)
+    "MUR500": "gang-collective-inventory",
+    "MUR501": "gang-bucket-recompile",
 }
 
 

@@ -75,9 +75,33 @@ def app():
                    "telemetry round window; with no telemetry.profile_rounds "
                    "configured the whole run is captured. Implies telemetry "
                    "(docs/OBSERVABILITY.md).")
+@click.option("--seeds", "num_seeds", type=int, default=None,
+              help="Gang-batch N seeds (experiment.seed .. +N-1) into one "
+                   "vmapped program — sugar for `murmura sweep` with "
+                   "num_seeds: N (docs/PERFORMANCE.md). 1 = normal run.")
 def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
-        resume, device, profile):
+        resume, device, profile, num_seeds):
     """Run an experiment from a config file (reference: cli.py:34-60)."""
+    if num_seeds is not None and num_seeds < 1:
+        raise click.UsageError(
+            f"--seeds must be >= 1 (got {num_seeds}); 1 = normal run, "
+            "N > 1 gang-batches N seeds"
+        )
+    if num_seeds is not None and num_seeds > 1:
+        if resume or checkpoint_dir is not None or profile:
+            raise click.UsageError(
+                "--seeds (gang-batched execution) does not combine with "
+                "--resume/--checkpoint-dir/--profile; use `murmura sweep` "
+                "semantics (per-member telemetry manifests instead)"
+            )
+        config = _load_config_or_die(config_path)
+        if verbose is not None:
+            config.experiment.verbose = verbose
+        base = config.experiment.seed
+        return _run_sweep(
+            config, seeds=[base + i for i in range(num_seeds)],
+            output=output, device=device,
+        )
     if device is not None:
         # Must land before anything initializes the XLA backend.
         import jax
@@ -167,6 +191,116 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
             "with `murmura report <dir>`"
         )
     return history
+
+
+def _run_sweep(config, seeds, output, device):
+    """Shared gang-sweep driver (`murmura sweep` and `murmura run --seeds`):
+    build the gang, train, render the per-member summary, write per-member
+    histories."""
+    if device is not None:
+        # Must land before anything initializes the XLA backend.
+        import jax
+
+        jax.config.update("jax_platforms", device)
+    from murmura_tpu.utils.factories import ConfigError, build_gang_from_config
+
+    try:
+        gang = build_gang_from_config(config, seeds=seeds)
+    except ConfigError as e:
+        _die_config_error(e)
+    console.print(
+        f"[bold cyan]murmura_tpu[/bold cyan] sweep "
+        f"[bold]{config.experiment.name}[/bold] "
+        f"(backend={config.backend}, nodes={config.topology.num_nodes}, "
+        f"rounds={config.experiment.rounds}, "
+        f"gang={gang.gang_size} member(s), batch={gang.batch})"
+    )
+    histories = gang.train(
+        rounds=config.experiment.rounds,
+        verbose=config.experiment.verbose,
+        rounds_per_dispatch=config.tpu.rounds_per_dispatch,
+    )
+
+    table = Table(title="Sweep results (final round)")
+    table.add_column("Member")
+    table.add_column("Mean acc", justify="right")
+    table.add_column("Std", justify="right")
+    table.add_column("Loss", justify="right")
+    for member, h in zip(gang.members, histories):
+        if h["round"]:
+            table.add_row(
+                member.label,
+                f"{h['mean_accuracy'][-1]:.4f}",
+                f"{h['std_accuracy'][-1]:.4f}",
+                f"{h['mean_loss'][-1]:.4f}",
+            )
+        else:
+            table.add_row(member.label, "-", "-", "-")
+    console.print(table)
+    finals = [h["mean_accuracy"][-1] for h in histories if h["round"]]
+    if finals:
+        import numpy as np
+
+        console.print(
+            f"Across {len(finals)} member(s): mean accuracy "
+            f"[bold green]{np.mean(finals):.4f}[/bold green] "
+            f"± {np.std(finals):.4f}"
+        )
+
+    combined = {m.label: h for m, h in zip(gang.members, histories)}
+    if output is not None:
+        output.parent.mkdir(parents=True, exist_ok=True)
+        output.write_text(json.dumps(combined, indent=2))
+        console.print(f"Per-member histories written to [bold]{output}[/bold]")
+    if config.telemetry.enabled:
+        from murmura_tpu.utils.factories import default_telemetry_dir
+
+        console.print(
+            f"Per-member telemetry runs under "
+            f"[bold]{default_telemetry_dir(config)}/<member>[/bold] — "
+            "render one with `murmura report <dir>`"
+        )
+    return combined
+
+
+@app.command()
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--seeds", "seeds", type=str, default=None,
+              help="Comma-separated member seeds overriding the config's "
+                   "sweep block (e.g. --seeds 1,2,3)")
+@click.option("--verbose/--quiet", "verbose", default=None,
+              help="Override config verbosity")
+@click.option("--output", "-o", type=click.Path(path_type=Path), default=None,
+              help="Write the per-member history JSON (one object keyed by "
+                   "member label) here")
+@click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
+              help="Force the JAX platform")
+def sweep(config_path: Path, seeds, verbose, output, device):
+    """Gang-batched multi-seed execution (docs/PERFORMANCE.md).
+
+    Stacks the sweep's member experiments — the config's ``sweep:`` block,
+    or an explicit ``--seeds`` list — along a leading [S] axis and vmaps
+    the round program over it: ONE XLA compile and one saturated device
+    program cover the whole sweep.  Per-member histories are byte-identical
+    on CPU to the corresponding single runs (`murmura check --ir` MUR500/
+    MUR501 keep the gang collective- and recompile-clean).
+    """
+    config = _load_config_or_die(config_path)
+    if verbose is not None:
+        config.experiment.verbose = verbose
+    seed_list = None
+    if seeds is not None:
+        try:
+            seed_list = [int(s) for s in seeds.split(",") if s.strip()]
+        except ValueError:
+            raise click.UsageError(f"--seeds must be comma-separated ints, got {seeds!r}")
+        if not seed_list:
+            raise click.UsageError("--seeds parsed to an empty list")
+    elif config.sweep is None:
+        raise click.UsageError(
+            "config has no sweep block; add one or pass --seeds 1,2,3"
+        )
+    return _run_sweep(config, seeds=seed_list, output=output, device=device)
 
 
 @app.command("run-node")

@@ -10,6 +10,8 @@ from murmura_tpu.config.schema import (
     ExperimentConfig,
     MobilityConfig,
     ModelConfig,
+    SweepConfig,
+    SweepMemberConfig,
     TopologyConfig,
     TPUConfig,
     TrainingConfig,
@@ -29,6 +31,8 @@ __all__ = [
     "ModelConfig",
     "DistributedConfig",
     "TPUConfig",
+    "SweepConfig",
+    "SweepMemberConfig",
     "load_config",
     "save_config",
 ]

@@ -249,6 +249,102 @@ class TelemetryConfig(_Strict):
     )
 
 
+class SweepMemberConfig(_Strict):
+    """One gang member's overrides (core/gang.py; docs/PERFORMANCE.md).
+
+    A member is the base experiment with a different seed and optionally
+    different *traced-scalar* hyperparameters — values the compiled round
+    program takes as inputs, so every member rides one jit.  Shape-affecting
+    knobs (num_nodes, batch_size, krum's num_compromised selection count,
+    model size) cannot vary inside a gang: they change the traced program
+    and belong in separate sweeps.
+    """
+
+    seed: Optional[int] = Field(
+        default=None,
+        description="Member experiment seed (default: experiment.seed)",
+    )
+    lr: Optional[float] = Field(
+        default=None, gt=0.0,
+        description="Member learning-rate override (default: training.lr)",
+    )
+    attack_scale: Optional[float] = Field(
+        default=None, ge=0.0,
+        description=(
+            "Multiplier on the attack's broadcast perturbation "
+            "(bcast = own + scale * (attacked - own)); 1.0 = the configured "
+            "attack, 0.0 = attack off for this member"
+        ),
+    )
+    noise_std: Optional[float] = Field(
+        default=None, ge=0.0,
+        description=(
+            "Gaussian-attack noise std override — sugar for attack_scale = "
+            "noise_std / attack.params.noise_std (gaussian attacks only)"
+        ),
+    )
+
+
+class SweepConfig(_Strict):
+    """Gang-batched multi-seed execution (murmura_tpu extension; ISSUE 5 —
+    docs/PERFORMANCE.md).
+
+    Stacks S independent experiments — differing in seed and optionally in
+    traced scalar hyperparameters — into leading-axis-[S, ...] inputs and
+    ``jax.vmap``s the round program over that axis: one XLA compile and one
+    saturated device program cover the whole sweep instead of S compiles +
+    S underfilled executions.  ``sweep:`` absent => byte-identical behavior
+    to today; with it, each member's history is byte-identical on CPU to
+    the single run with that member's seed (gang-parity contract,
+    tests/test_gang.py).
+    """
+
+    seeds: Optional[List[int]] = Field(
+        default=None,
+        description="Explicit member seeds (one gang member per entry)",
+    )
+    num_seeds: Optional[int] = Field(
+        default=None, ge=1,
+        description=(
+            "Sugar for seeds = [experiment.seed, experiment.seed + 1, ...]"
+        ),
+    )
+    members: Optional[List[SweepMemberConfig]] = Field(
+        default=None,
+        description=(
+            "Explicit member list with per-member hyperparameter overrides "
+            "(mutually exclusive with seeds/num_seeds)"
+        ),
+    )
+    bucket: bool = Field(
+        default=True,
+        description=(
+            "Pad the gang to the next power-of-two size so growing S within "
+            "a bucket reuses the compiled program (zero recompiles — check "
+            "--ir MUR501); padding members replicate member 0 and are "
+            "never recorded"
+        ),
+    )
+
+    @model_validator(mode="after")
+    def _exactly_one_member_source(self):
+        sources = [
+            s for s in (self.seeds, self.num_seeds, self.members)
+            if s is not None
+        ]
+        if len(sources) != 1:
+            raise ValueError(
+                "sweep needs exactly one of seeds / num_seeds / members"
+            )
+        if self.seeds is not None and len(self.seeds) != len(set(self.seeds)):
+            raise ValueError("sweep.seeds must be distinct")
+        if self.seeds is not None and not self.seeds:
+            raise ValueError("sweep.seeds must be non-empty")
+        if self.members is not None and not self.members:
+            raise ValueError("sweep.members must be non-empty")
+        return self
+
+
 class TrainingConfig(_Strict):
     """Local training hyperparameters (reference: murmura/config/schema.py:142-150)."""
 
@@ -454,6 +550,15 @@ class Config(_Strict):
             "default off => byte-identical to no telemetry block"
         ),
     )
+    sweep: Optional[SweepConfig] = Field(
+        default=None,
+        description=(
+            "Gang-batched multi-seed execution (`murmura sweep`): vmap the "
+            "round program over an [S] experiment axis — one compile, one "
+            "saturated dispatch for the whole sweep; absent => byte-"
+            "identical behavior to today"
+        ),
+    )
 
     @model_validator(mode="after")
     def _telemetry_requires_enabled(self):
@@ -470,6 +575,42 @@ class Config(_Strict):
                 "profile_rounds/profile_start_round/profile_dir/dir) "
                 "require telemetry.enabled: true"
             )
+        return self
+
+    @model_validator(mode="after")
+    def _sweep_is_wirable(self):
+        if self.sweep is None:
+            return self
+        if self.backend == "distributed":
+            raise ValueError(
+                "sweep (gang-batched execution) runs the vmapped round "
+                "program in one process; backend: distributed trains in "
+                "per-node OS processes — use backend: simulation or tpu"
+            )
+        for i, m in enumerate(self.sweep.members or []):
+            if m.noise_std is not None:
+                if not (
+                    self.attack.enabled and self.attack.type == "gaussian"
+                ):
+                    raise ValueError(
+                        f"sweep.members[{i}].noise_std requires an enabled "
+                        "gaussian attack (it rescales the gaussian "
+                        "perturbation); use attack_scale for other attacks"
+                    )
+                if m.attack_scale is not None:
+                    raise ValueError(
+                        f"sweep.members[{i}] sets both noise_std and "
+                        "attack_scale — they are two spellings of the same "
+                        "multiplier; pick one"
+                    )
+            if (
+                m.attack_scale is not None or m.noise_std is not None
+            ) and not self.attack.enabled:
+                raise ValueError(
+                    f"sweep.members[{i}] overrides the attack but "
+                    "attack.enabled is false — there is no perturbation "
+                    "to scale"
+                )
         return self
 
     @model_validator(mode="after")

@@ -1,7 +1,14 @@
 """Core runtime: the jitted round program and Network orchestrator
 (reference: murmura/core/)."""
 
+from murmura_tpu.core.gang import GangMember, GangNetwork
 from murmura_tpu.core.network import Network
 from murmura_tpu.core.rounds import RoundProgram, build_round_program
 
-__all__ = ["Network", "RoundProgram", "build_round_program"]
+__all__ = [
+    "GangMember",
+    "GangNetwork",
+    "Network",
+    "RoundProgram",
+    "build_round_program",
+]

@@ -21,6 +21,132 @@ from murmura_tpu.topology.base import Topology
 from murmura_tpu.topology.dynamic import MobilityModel
 
 
+def effective_adjacency(
+    topology, mobility, fault_schedule, round_idx: int
+) -> np.ndarray:
+    """One round's effective [N, N] adjacency: mobility G^t (or the static
+    mask) with the fault-schedule masks folded in host-side.  Shared by
+    the single-run orchestrator and the gang dispatch path (core/gang.py)
+    so the fold-in semantics cannot drift between them."""
+    if mobility is not None:
+        adj = mobility.adjacency_at(round_idx).astype(np.float32)
+    else:
+        adj = topology.mask()
+    if fault_schedule is not None:
+        # adj * alive_i * alive_j * link_mask * straggler columns —
+        # folded host-side so the compiled program only ever sees a
+        # differently-valued adjacency input.
+        adj = fault_schedule.masked_adjacency(adj, round_idx)
+    return adj
+
+
+def effective_alive(fault_schedule, num_nodes: int, round_idx: int) -> np.ndarray:
+    """[N] float32 alive mask for a faulted program's extra input (shared
+    single-run/gang helper, see :func:`effective_adjacency`)."""
+    if fault_schedule is not None:
+        return fault_schedule.alive_at(round_idx)
+    return np.ones(num_nodes, dtype=np.float32)
+
+
+@contextlib.contextmanager
+def sanitizer_scope(owner):
+    """Arm the opt-in runtime sanitizers around one train() call.
+
+    ``owner`` (Network or GangNetwork — one shared contract) provides
+    ``transfer_guard``/``recompile_guard`` flags and receives ``_tracker``
+    during the scope plus ``last_compile_report`` on exit.
+
+    ``tpu.transfer_guard``: jax.transfer_guard("disallow") over the round
+    loop — the loop's deliberate transfers are explicit (jnp.asarray /
+    device_put / device_get) and pass; implicit traffic raises.
+    ``tpu.recompile_guard``: a CompileTracker the round loops bracket each
+    round with; post-warmup compiles raise RecompileError.
+    """
+    with contextlib.ExitStack() as stack:
+        if owner.transfer_guard:
+            from murmura_tpu.analysis.sanitizers import transfer_sanitizer
+
+            stack.enter_context(transfer_sanitizer())
+        if owner.recompile_guard:
+            from murmura_tpu.analysis.sanitizers import track_compiles
+
+            owner._tracker = stack.enter_context(track_compiles())
+        try:
+            yield
+        finally:
+            if owner._tracker is not None:
+                owner.last_compile_report = list(owner._tracker.per_round)
+            owner._tracker = None
+
+
+def empty_history() -> Dict[str, List[Any]]:
+    """The reference's history schema (network.py:47-58) — shared by the
+    single-run orchestrator and the gang dispatch path (core/gang.py) so
+    the two cannot drift."""
+    return {
+        "round": [],
+        "mean_accuracy": [],
+        "std_accuracy": [],
+        "mean_loss": [],
+        "honest_accuracy": [],
+        "compromised_accuracy": [],
+        "mean_vacuity": [],
+        "mean_entropy": [],
+        "mean_strength": [],
+    }
+
+
+def record_round_metrics(
+    history: Dict[str, List[Any]],
+    round_num: int,
+    metrics: Dict[str, np.ndarray],
+    compromised: np.ndarray,
+    evidential: bool,
+    has_attack: bool,
+) -> Dict[str, np.ndarray]:
+    """Append one evaluated round to ``history``; returns the round's raw
+    per-node ``agg_*`` stats (the ``get_node_statistics`` source).
+
+    This is the single source of truth for how device metrics become
+    history floats — the gang-parity contract (a gang member's history is
+    byte-identical to its single run, tests/test_gang.py) rides on both
+    paths sharing it.
+    """
+    acc = np.asarray(metrics["accuracy"])
+    loss = np.asarray(metrics["loss"])
+    comp = np.asarray(compromised) > 0
+
+    history["round"].append(round_num)
+    history["mean_accuracy"].append(float(acc.mean()))
+    history["std_accuracy"].append(float(acc.std()))
+    history["mean_loss"].append(float(loss.mean()))
+    if has_attack and comp.any():
+        history["honest_accuracy"].append(float(acc[~comp].mean()))
+        history["compromised_accuracy"].append(float(acc[comp].mean()))
+    if evidential:
+        history["mean_vacuity"].append(float(np.asarray(metrics["vacuity"]).mean()))
+        history["mean_entropy"].append(float(np.asarray(metrics["entropy"]).mean()))
+        history["mean_strength"].append(
+            float(np.asarray(metrics["strength"]).mean())
+        )
+
+    last_stats = {
+        k[len("agg_"):]: np.asarray(v)
+        for k, v in metrics.items()
+        if k.startswith("agg_")
+    }
+    # Per-round rule statistics (acceptance rates, thresholds, trust...)
+    # accumulate in the history under their agg_ keys — the reference
+    # buries these in aggregator-internal lists surfaced only via
+    # get_statistics() (e.g. balance.py:46-53).
+    for k, v in last_stats.items():
+        arr = np.asarray(v, dtype=np.float64)
+        history.setdefault(f"agg_{k}", []).append(
+            float(arr.mean()) if arr.ndim else float(arr)
+        )
+    return last_stats
+
+
 class Network:
     """Orchestrates decentralized FL over a compiled round program."""
 
@@ -150,17 +276,7 @@ class Network:
         )
 
         # History schema parity (reference: network.py:47-58)
-        self.history: Dict[str, List[Any]] = {
-            "round": [],
-            "mean_accuracy": [],
-            "std_accuracy": [],
-            "mean_loss": [],
-            "honest_accuracy": [],
-            "compromised_accuracy": [],
-            "mean_vacuity": [],
-            "mean_entropy": [],
-            "mean_strength": [],
-        }
+        self.history: Dict[str, List[Any]] = empty_history()
         self._last_stats: Dict[str, np.ndarray] = {}
         self._donate = donate
         self._fused_cache: Dict[Any, Any] = {}
@@ -206,24 +322,18 @@ class Network:
         return jax.device_put(value, sharding)
 
     def _adjacency_for_round(self, round_idx: int) -> np.ndarray:
-        if self.mobility is not None:
-            adj = self.mobility.adjacency_at(round_idx).astype(np.float32)
-        else:
-            adj = self.topology.mask()
-        if self.fault_schedule is not None:
-            # adj * alive_i * alive_j * link_mask * straggler columns —
-            # folded host-side so the compiled program only ever sees a
-            # differently-valued adjacency input.
-            adj = self.fault_schedule.masked_adjacency(adj, round_idx)
+        adj = effective_adjacency(
+            self.topology, self.mobility, self.fault_schedule, round_idx
+        )
         if self.telemetry is not None:
             self._in_degree_cache[round_idx] = np.asarray(adj).sum(axis=0)
         return adj
 
     def _alive_for_round(self, round_idx: int) -> np.ndarray:
         """[N] float32 alive mask for a faulted program's extra input."""
-        if self.fault_schedule is not None:
-            return self.fault_schedule.alive_at(round_idx)
-        return np.ones(self.program.num_nodes, dtype=np.float32)
+        return effective_alive(
+            self.fault_schedule, self.program.num_nodes, round_idx
+        )
 
     def step_cost_analysis(self) -> Dict[str, float]:
         """XLA cost analysis of the compiled train step (flops, bytes).
@@ -371,31 +481,9 @@ class Network:
                 trace_dir=t.profile_dir or str(t.run_dir / "trace"),
             )
 
-    @contextlib.contextmanager
     def _sanitizer_scope(self):
-        """Arm the opt-in runtime sanitizers around one train() call.
-
-        ``tpu.transfer_guard``: jax.transfer_guard("disallow") over the
-        round loop — the loop's deliberate transfers are explicit
-        (jnp.asarray / device_get) and pass; implicit traffic raises.
-        ``tpu.recompile_guard``: a CompileTracker the round loops bracket
-        each round with; post-warmup compiles raise RecompileError.
-        """
-        with contextlib.ExitStack() as stack:
-            if self.transfer_guard:
-                from murmura_tpu.analysis.sanitizers import transfer_sanitizer
-
-                stack.enter_context(transfer_sanitizer())
-            if self.recompile_guard:
-                from murmura_tpu.analysis.sanitizers import track_compiles
-
-                self._tracker = stack.enter_context(track_compiles())
-            try:
-                yield
-            finally:
-                if self._tracker is not None:
-                    self.last_compile_report = list(self._tracker.per_round)
-                self._tracker = None
+        """The shared :func:`sanitizer_scope` over this orchestrator."""
+        return sanitizer_scope(self)
 
     def _fused_step(self, chunk: int, eval_every: int):
         """Compiled fused multi-round program, cached per (chunk, cadence)."""
@@ -651,22 +739,10 @@ class Network:
 
     def _record(self, round_num: int, metrics: Dict[str, np.ndarray], verbose: bool):
         acc = np.asarray(metrics["accuracy"])
-        loss = np.asarray(metrics["loss"])
-        comp = self.compromised > 0
-
-        self.history["round"].append(round_num)
-        self.history["mean_accuracy"].append(float(acc.mean()))
-        self.history["std_accuracy"].append(float(acc.std()))
-        self.history["mean_loss"].append(float(loss.mean()))
-        if self.attack is not None and comp.any():
-            self.history["honest_accuracy"].append(float(acc[~comp].mean()))
-            self.history["compromised_accuracy"].append(float(acc[comp].mean()))
-        if self.program.evidential:
-            self.history["mean_vacuity"].append(float(np.asarray(metrics["vacuity"]).mean()))
-            self.history["mean_entropy"].append(float(np.asarray(metrics["entropy"]).mean()))
-            self.history["mean_strength"].append(
-                float(np.asarray(metrics["strength"]).mean())
-            )
+        last_stats = record_round_metrics(
+            self.history, round_num, metrics, self.compromised,
+            self.program.evidential, self.attack is not None,
+        )
 
         if self.telemetry is not None:
             # Per-node arrays of the recorded round (accuracy, agg_* rule
@@ -693,22 +769,10 @@ class Network:
                 {k: np.asarray(v) for k, v in metrics.items()},
                 in_degree=in_deg,
             )
-        self._last_stats = {
-            k[len("agg_"):]: np.asarray(v)
-            for k, v in metrics.items()
-            if k.startswith("agg_")
-        }
-        # Per-round rule statistics (acceptance rates, thresholds, trust...)
-        # accumulate in the history under their agg_ keys — the reference
-        # buries these in aggregator-internal lists surfaced only via
-        # get_statistics() (e.g. balance.py:46-53).
-        for k, v in self._last_stats.items():
-            arr = np.asarray(v, dtype=np.float64)
-            self.history.setdefault(f"agg_{k}", []).append(
-                float(arr.mean()) if arr.ndim else float(arr)
-            )
+        self._last_stats = last_stats
 
         if verbose:
+            comp = self.compromised > 0
             line = f"Round {round_num}: Mean Accuracy = {acc.mean():.4f} ± {acc.std():.4f}"
             print(line, flush=True)
             if self.attack is not None and comp.any():

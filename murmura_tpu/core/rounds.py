@@ -85,6 +85,11 @@ class RoundProgram:
     # sentinel quarantines non-finite updates).  False => the signature and
     # traced program are byte-identical to pre-faults builds.
     faulted: bool = False
+    # Traced-scalar hyperparameters lifted from closure constants into
+    # ``data_arrays["hp_*"]`` inputs (build_round_program(hp_inputs=...)) so
+    # a gang (core/gang.py) can vary them per member under vmap.  () =>
+    # the traced program is byte-identical to pre-gang builds.
+    hp_inputs: Tuple[str, ...] = ()
 
 
 def _broadcast_to_leaf(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
@@ -111,6 +116,7 @@ def build_round_program(
     node_axis_sharded: bool = False,
     faults: Optional[FaultSpec] = None,
     audit_taps: bool = False,
+    hp_inputs: Tuple[str, ...] = (),
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -140,10 +146,29 @@ def build_round_program(
             metrics.  Taps are collective- and recompile-clean by
             contract (``murmura check --ir`` MUR400/MUR402); False
             (default) leaves the traced program byte-identical.
+        hp_inputs: scalar hyperparameters to lift from trace-time closure
+            constants into round-program *inputs* riding ``data_arrays``
+            (gang-batched execution, core/gang.py — a vmapped gang member
+            gets its own value from the [S]-leading stacked entry):
+            ``"lr"`` => the SGD step reads ``d["hp_lr"]``;
+            ``"attack_scale"`` => the attack's broadcast perturbation is
+            scaled by ``d["hp_attack_scale"]``
+            (``own + scale * (attacked - own)``; requires an attack).
+            () (default) leaves the traced program byte-identical.
     """
     n = data.num_nodes
     num_classes = data.num_classes or model.num_classes
     evidential = model.evidential
+
+    hp_inputs = tuple(hp_inputs)
+    unknown_hp = set(hp_inputs) - {"lr", "attack_scale"}
+    if unknown_hp:
+        raise ValueError(f"unknown hp_inputs: {sorted(unknown_hp)}")
+    if "attack_scale" in hp_inputs and attack is None:
+        raise ValueError(
+            "hp_inputs includes 'attack_scale' but no attack is configured "
+            "— there is no broadcast perturbation to scale"
+        )
 
     # ---- static per-node batch schedule (network.py:278-287) -------------
     eff_batch = data.effective_batch(batch_size)  # [N]
@@ -191,6 +216,14 @@ def build_round_program(
         "eval_y": eval_y,
         "eval_mask": eval_mask,
     }
+    # Lifted scalar hyperparameters ride the data dict (one input pytree to
+    # thread, one sharding rule: rank-0 leaves replicate).  The defaults
+    # reproduce the closure-constant behavior exactly — x * 1.0 and a
+    # traced scalar holding the same f32 value multiply bit-identically.
+    if "lr" in hp_inputs:
+        data_arrays["hp_lr"] = np.asarray(lr, np.float32)
+    if "attack_scale" in hp_inputs:
+        data_arrays["hp_attack_scale"] = np.asarray(1.0, np.float32)
 
     # ---- per-node loss ----------------------------------------------------
     def node_loss(params_i, xb, yb, mb, key, round_idx):  # murmura: traced
@@ -228,12 +261,15 @@ def build_round_program(
                     params, xb, yb, batch_mask, node_keys, round_idx
                 )
                 update = honest * (t < d["steps"]).astype(jnp.float32)  # [N]
+                # lr is a closure constant unless lifted to an input
+                # (hp_inputs — gang members vary it per member under vmap).
+                eff_lr = d["hp_lr"] if "lr" in hp_inputs else lr
                 # Update math in float32, cast back: keeps bf16 params
                 # (tpu.param_dtype) dtype-stable through the scan carry and
                 # rounds once per step instead of per multiply.
                 new_params = jax.tree_util.tree_map(
                     lambda p, g: (
-                        p - lr * _broadcast_to_leaf(update, p) * g.astype(jnp.float32)
+                        p - eff_lr * _broadcast_to_leaf(update, p) * g.astype(jnp.float32)
                     ).astype(p.dtype),
                     params,
                     grads,
@@ -393,6 +429,19 @@ def build_round_program(
                 bcast = attack_apply(
                     own_flat, compromised, attack_key, round_idx
                 ).astype(own_flat.dtype)
+            if "attack_scale" in hp_inputs:
+                # Per-member attack intensity (gang sweeps): scale the
+                # perturbation the attack added to the broadcast.  For
+                # additive attacks (gaussian/directed/alie/ipm noise or
+                # deviation terms) this is the attack's own magnitude
+                # knob; scale 0 turns the member's attack off.  Placed
+                # BEFORE the sentinel scrub so an amplified-to-inf
+                # perturbation is still contained.
+                scale = d["hp_attack_scale"].astype(jnp.float32)
+                bcast = (
+                    own_flat.astype(jnp.float32)
+                    + scale * (bcast - own_flat).astype(jnp.float32)
+                ).astype(own_flat.dtype)
             if finite is not None:
                 # Second sentinel stage: the pre-training check cannot see
                 # an ATTACK that overflows to inf/NaN (huge noise_std,
@@ -520,6 +569,7 @@ def build_round_program(
         model_dim=model_dim,
         evidential=evidential,
         faulted=faults is not None,
+        hp_inputs=hp_inputs,
     )
 
 

@@ -192,6 +192,180 @@ def shard_multi_round(multi_round, program, mesh: Mesh, donate: bool = True):
     )
 
 
+# --------------------------------------------------------------------------
+# Gang-batched execution (core/gang.py): the [B] experiment axis joins the
+# mesh as a second dimension.
+# --------------------------------------------------------------------------
+
+
+def make_gang_mesh(
+    batch: int, num_nodes: int, num_devices: Optional[int] = None
+) -> Mesh:
+    """2-D ("seed", "nodes") mesh for a gang of ``batch`` members.
+
+    Layout policy (ISSUE 5): **seed-major** when the whole gang fits —
+    ``batch * num_nodes <= devices`` puts every (member, node) pair on its
+    own device (maximum parallelism, zero per-member serialization);
+    otherwise the largest seed-axis factor that divides both the device
+    count and the gang, falling back to a pure node-sharded mesh with the
+    seed axis replicated (size 1) — each device then holds all B members of
+    its node rows, which is the right layout when N is large and B small.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"Requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    n_dev = len(devices)
+    if batch * num_nodes <= n_dev:
+        sel = np.array(devices[: batch * num_nodes])
+        return Mesh(sel.reshape(batch, num_nodes), ("seed", "nodes"))
+    for s in sorted(range(1, n_dev + 1), reverse=True):
+        if n_dev % s == 0 and s <= batch and batch % s == 0:
+            if num_nodes % (n_dev // s) == 0:
+                return Mesh(
+                    np.array(devices).reshape(s, n_dev // s),
+                    ("seed", "nodes"),
+                )
+    raise ValueError(
+        f"cannot lay a gang of {batch} members x {num_nodes} nodes onto "
+        f"{n_dev} devices: no (seed, nodes) factorization divides both "
+        "axes — adjust tpu.num_devices or the gang size"
+    )
+
+
+def _shard_gang_leading(tree: Any, mesh: Mesh) -> Any:
+    """Sharding pytree for *stacked* [B, ...] gang state: [B, N, ...]
+    leaves split ("seed", "nodes"), [B] per-member leaves split ("seed",),
+    rank-0 leaves replicate.  Leaves whose second axis is not the node
+    axis (or not divisible by it) stay seed-sharded only."""
+    gang2d = NamedSharding(mesh, P("seed", "nodes"))
+    member = NamedSharding(mesh, P("seed"))
+    repl = NamedSharding(mesh, P())
+    node_ax = mesh.shape["nodes"]
+
+    def spec(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return repl
+        if leaf.ndim >= 2 and leaf.shape[1] % node_ax == 0:
+            return gang2d
+        return member
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def _gang_spec_from_template(tree: Any, mesh: Mesh) -> Any:
+    """Sharding pytree for stacked gang inputs derived from the UNSTACKED
+    per-member template (program.init_params / init_agg_state /
+    data_arrays): a member leaf of rank >= 1 gains the gang axis in front
+    ([B, N, ...] -> ("seed", "nodes")); a rank-0 member leaf becomes a [B]
+    per-member vector (("seed",))."""
+    gang2d = NamedSharding(mesh, P("seed", "nodes"))
+    member = NamedSharding(mesh, P("seed"))
+    node_ax = mesh.shape["nodes"]
+
+    def spec(leaf):
+        leaf = np.asarray(leaf)
+        if leaf.ndim >= 1 and leaf.shape[0] % node_ax == 0:
+            return gang2d
+        return member
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def gang_node_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of member-shared node-leading arrays (the [N, N] adjacency,
+    the [N] alive mask): node rows split over the ``nodes`` axis, values
+    replicated along ``seed``."""
+    return NamedSharding(mesh, P("nodes"))
+
+
+def gang_adj_stack_sharding(mesh: Mesh) -> NamedSharding:
+    """Fused-dispatch [chunk, N, N] adjacency stack (shared across
+    members): sharded on the node (second) axis, replicated along seed."""
+    return NamedSharding(mesh, P(None, "nodes"))
+
+
+def _shard_gang_round_fn(
+    vfn, program, batch: int, mesh: Mesh, adj_sharding, donate: bool,
+    alive_sharding,
+):
+    """Jit a vmapped round-shaped gang program with in/out shardings pinned
+    — the gang twin of :func:`_shard_round_fn`.  The vmapped signature is
+    the single-run one with the stacked args gaining a [B] leading axis
+    (params, agg_state, keys, compromised, data) and the member-shared args
+    (adjacency, alive, round index) unbatched."""
+    seed_ax, node_ax = mesh.shape["seed"], mesh.shape["nodes"]
+    if batch % seed_ax != 0:
+        raise ValueError(
+            f"gang batch={batch} not divisible by mesh seed axis {seed_ax}"
+        )
+    if program.num_nodes % node_ax != 0:
+        raise ValueError(
+            f"num_nodes={program.num_nodes} not divisible by mesh node "
+            f"axis {node_ax}"
+        )
+    member = NamedSharding(mesh, P("seed"))
+    repl = NamedSharding(mesh, P())
+    gang2d = NamedSharding(mesh, P("seed", "nodes"))
+
+    params_s = _gang_spec_from_template(program.init_params, mesh)
+    agg_s = _gang_spec_from_template(program.init_agg_state, mesh)
+    data_s = _gang_spec_from_template(program.data_arrays, mesh)
+
+    in_shardings = [
+        params_s,  # stacked params [B, N, ...]
+        agg_s,  # stacked agg state
+        member,  # per-member rng keys [B, 2]
+        adj_sharding,  # shared adjacency (rows or stack)
+        gang2d,  # stacked compromised masks [B, N]
+        repl,  # round index
+        data_s,  # stacked data dict
+    ]
+    if program.faulted:
+        in_shardings.insert(5, alive_sharding)
+    return jax.jit(
+        vfn,
+        in_shardings=tuple(in_shardings),
+        out_shardings=(params_s, agg_s, repl),
+        donate_argnums=(0, 1) if donate else (),
+    )
+
+
+def shard_gang_step(vstep, program, batch: int, mesh: Mesh, donate: bool = True):
+    """Jit the vmapped per-round gang step over a ("seed", "nodes") mesh."""
+    return _shard_gang_round_fn(
+        vstep, program, batch, mesh, gang_node_sharding(mesh), donate,
+        alive_sharding=gang_node_sharding(mesh),
+    )
+
+
+def shard_gang_multi_round(
+    vmulti, program, batch: int, mesh: Mesh, donate: bool = True
+):
+    """Jit the vmapped fused gang scan; the shared [chunk, N, N] adjacency
+    stack (and [chunk, N] alive stack) shard on their node axis."""
+    return _shard_gang_round_fn(
+        vmulti, program, batch, mesh, gang_adj_stack_sharding(mesh), donate,
+        alive_sharding=gang_adj_stack_sharding(mesh),
+    )
+
+
+def shard_gang_eval_step(veval, program, batch: int, mesh: Mesh):
+    """Jit the vmapped gang eval step; metrics replicate for the same
+    multi-host device_get reason as :func:`shard_eval_step`."""
+    params_s = _gang_spec_from_template(program.init_params, mesh)
+    data_s = _gang_spec_from_template(program.data_arrays, mesh)
+    repl = NamedSharding(mesh, P())
+    return jax.jit(
+        veval,
+        in_shardings=(params_s, data_s),
+        out_shardings=repl,
+    )
+
+
 def shard_eval_step(eval_step, program, mesh: Mesh):
     """Jit a RoundProgram eval step (params, data) -> metrics over ``mesh``.
 

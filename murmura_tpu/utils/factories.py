@@ -28,8 +28,15 @@ def build_attack(config: Config) -> Optional[Attack]:
         return None
     n = config.topology.num_nodes
     pct = config.attack.percentage
-    seed = config.experiment.seed
     p = config.attack.params
+    # Compromised-set selection seed.  Defaults to the experiment seed (the
+    # reference's behavior); an explicit attack.params.seed pins the
+    # Byzantine placement independently of experiment.seed — the knob gang
+    # sweeps (core/gang.py) rely on: a gang varies member seeds under ONE
+    # traced program whose attack closures (e.g. the gaussian scatter
+    # matrix) bake in a static compromised set, so the placement must not
+    # follow the member seed.
+    seed = int(p.get("seed", config.experiment.seed))
 
     if config.attack.type == "gaussian":
         # "std" is the reference's alternate key for the noise scale
@@ -332,6 +339,15 @@ def apply_compilation_cache(config: Config) -> None:
         jax.config.update(
             "jax_compilation_cache_dir", config.tpu.compilation_cache_dir
         )
+        # Process-level twin for jax-config-free consumers (the check
+        # --ir budget sweep — analysis/budgets.apply_persistent_cache —
+        # and any subprocess this run spawns): one cache per battery.
+        import os
+
+        os.environ.setdefault(
+            "MURMURA_COMPILATION_CACHE_DIR",
+            config.tpu.compilation_cache_dir,
+        )
 
 
 def _node_axis_sharded(config: Config, mesh=None) -> bool:
@@ -350,6 +366,207 @@ def _node_axis_sharded(config: Config, mesh=None) -> bool:
     import jax
 
     return jax.device_count() > 1
+
+
+def build_gang_from_config(config: Config, seeds=None, mesh=None):
+    """Gang wiring (core/gang.py): one traced round program, S stacked
+    member experiments — the ``murmura sweep`` / ``murmura run --seeds``
+    path.
+
+    Mirrors :func:`build_network_from_config` except that data, initial
+    params, RNG bases and (optionally) traced scalar hyperparameters are
+    built per member and stacked along a leading [S] axis, while the
+    attack placement, topology, mobility and fault schedule stay shared
+    (their seeds are independent of the experiment seed by construction —
+    ``attack.params.seed`` defaults to the BASE config's experiment seed
+    here so member programs share the attack's static closures).
+
+    ``seeds``: explicit member-seed override (the CLI ``--seeds`` flag);
+    otherwise ``config.sweep`` defines the members.
+    """
+    import os
+
+    from murmura_tpu.core.gang import (
+        GangNetwork,
+        gang_hp_inputs,
+        next_bucket,
+        resolve_members,
+    )
+    from murmura_tpu.core.rounds import build_round_program as _build_program
+
+    if config.backend == "distributed":
+        raise ConfigError(
+            "gang-batched sweeps need the jitted backends; backend: "
+            "distributed trains in per-node OS processes (run seeds as "
+            "separate invocations there)"
+        )
+    if config.backend == "tpu" and config.tpu.multihost and mesh is None:
+        from murmura_tpu.parallel.mesh import init_multihost
+
+        init_multihost(
+            coordinator_address=config.tpu.coordinator_address,
+            num_processes=config.tpu.num_processes,
+            process_id=config.tpu.process_id,
+        )
+    apply_compilation_cache(config)
+
+    try:
+        members = resolve_members(config, seeds)
+    except ValueError as e:
+        raise ConfigError(str(e))
+    hp_inputs = gang_hp_inputs(members)
+    bucket = config.sweep.bucket if config.sweep is not None else True
+    batch = next_bucket(len(members)) if bucket else len(members)
+
+    n = config.topology.num_nodes
+    rounds = config.experiment.rounds
+    topology = create_topology(
+        config.topology.type,
+        num_nodes=n,
+        p=config.topology.p,
+        k=config.topology.k,
+        seed=config.topology.seed,
+    )
+    # ONE attack for the whole gang: its compromised placement is seeded by
+    # attack.params.seed (default: the base experiment seed), never by the
+    # member seed — member programs share the attack's static closures
+    # (e.g. the gaussian scatter matrix).  A single run reproduces a gang
+    # member exactly by pinning attack.params.seed to this gang's base.
+    attack = build_attack(config)
+    mobility = build_mobility(config)
+
+    if config.backend == "tpu" and mesh is None:
+        from murmura_tpu.parallel.mesh import make_gang_mesh
+
+        mesh = make_gang_mesh(batch, n, config.tpu.num_devices)
+    node_axis_sharded = (
+        mesh is not None and dict(mesh.shape).get("nodes", 1) > 1
+    )
+
+    dmtt = None
+    if config.dmtt is not None:
+        from murmura_tpu.dmtt.protocol import DMTTParams
+
+        dmtt = DMTTParams(**config.dmtt.model_dump(exclude={"allow_static"}))
+
+    model = None
+    agg = None
+    probe_size = config.training.batch_size
+    member_programs = []
+    for i, member in enumerate(members):
+        data = build_federated_data(
+            config.data.adapter,
+            config.data.params,
+            num_nodes=n,
+            seed=member.seed,
+            max_samples=config.training.max_samples,
+        )
+        if attack is not None and attack.data_poison_fn is not None:
+            if data.x_test is None:
+                raise ConfigError(
+                    "data-poisoning attacks need a clean eval split: this "
+                    "adapter/config evaluates on the training shard "
+                    "(holdout_fraction: 0.0); set holdout_fraction > 0 or "
+                    "use an adapter with test shards"
+                )
+            data.y = attack.data_poison_fn(data.y, data.mask, data.num_classes)
+        if i == 0:
+            model = resolve_model(config, data)
+            agg_params = dict(config.aggregation.params)
+            if config.backend == "tpu" and config.tpu.exchange == "ppermute":
+                if mobility is not None or config.dmtt is not None:
+                    raise ConfigError(
+                        "tpu.exchange: ppermute requires a static circulant "
+                        "topology (mobility/dmtt graphs change per round)"
+                    )
+                offsets = topology.circulant_offsets()
+                if offsets is None:
+                    raise ConfigError(
+                        f"tpu.exchange: ppermute requires a circulant "
+                        f"topology (ring/k-regular); "
+                        f"'{config.topology.type}' is not"
+                    )
+                agg_params["exchange_offsets"] = offsets
+            if (
+                config.aggregation.algorithm
+                in ("krum", "median", "trimmed_mean", "geometric_median")
+                and mobility is None
+                and config.dmtt is None
+            ):
+                agg_params.setdefault(
+                    "max_candidates",
+                    int(topology.mask().sum(axis=1).max()) + 1,
+                )
+            if config.aggregation.algorithm == "evidential_trust":
+                probe_size = int(agg_params.get("max_eval_samples", 100))
+            from murmura_tpu.ops.flatten import model_dimension
+            import jax
+
+            model_dim = model_dimension(
+                jax.eval_shape(model.init, jax.random.PRNGKey(0))
+            )
+            agg = build_aggregator(
+                config.aggregation.algorithm, agg_params,
+                model_dim=model_dim, total_rounds=rounds,
+            )
+        member_programs.append(_build_program(
+            model,
+            agg,
+            data,
+            local_epochs=config.training.local_epochs,
+            batch_size=config.training.batch_size,
+            lr=member.lr if member.lr is not None else config.training.lr,
+            total_rounds=rounds,
+            attack=attack,
+            seed=member.seed,
+            probe_size=probe_size,
+            annealing_rounds=max(1, rounds // 2),
+            lambda_weight=0.1,
+            dmtt=dmtt,
+            param_dtype=resolved_param_dtype(config),
+            node_axis_sharded=node_axis_sharded,
+            faults=build_fault_spec(config),
+            audit_taps=config.telemetry.audit_taps,
+            hp_inputs=hp_inputs,
+        ))
+
+    writers = None
+    if config.telemetry.enabled:
+        base_dir = default_telemetry_dir(config)
+        writers = []
+        for member in members:
+            mcfg = config.model_copy(deep=True)
+            mcfg.experiment.seed = member.seed
+            mcfg.telemetry.dir = os.path.join(base_dir, member.label)
+            writers.append(build_telemetry_writer(mcfg))
+
+    try:
+        return GangNetwork(
+            program=member_programs[0],
+            member_programs=member_programs,
+            members=members,
+            topology=topology,
+            attack=attack,
+            mobility=mobility,
+            fault_schedule=build_fault_schedule(config),
+            backend=(
+                config.backend
+                if config.backend in ("simulation", "tpu")
+                else "simulation"
+            ),
+            mesh=mesh,
+            num_devices=config.tpu.num_devices,
+            donate=config.tpu.donate_state,
+            bucket=bucket,
+            base_lr=config.training.lr,
+            recompile_guard=config.tpu.recompile_guard,
+            transfer_guard=config.tpu.transfer_guard,
+            telemetry_writers=writers,
+        )
+    except ValueError as e:
+        # Gang-batchability failures (ragged member shapes, unfactorable
+        # mesh) are wiring-level config errors — render as messages.
+        raise ConfigError(str(e))
 
 
 def build_network_from_config(

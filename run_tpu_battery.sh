@@ -11,16 +11,23 @@
 set -u
 CHAOS=0
 PROFILE=0
+GANG=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
     --profile) PROFILE=1; shift;;
+    --gang) GANG=1; shift;;
     *) break;;
   esac
 done
 OUT="${1:-/root/repo/tpu_battery_results}"
 mkdir -p "$OUT"
 cd "$(dirname "$0")"
+# One persistent XLA compile cache for the whole battery: `murmura run`,
+# the benches (tpu.compilation_cache_dir) and the check --ir budget sweep
+# (analysis/budgets.apply_persistent_cache) all read this, so repeat
+# invocations skip identical compiles.
+export MURMURA_COMPILATION_CACHE_DIR="${MURMURA_COMPILATION_CACHE_DIR:-/tmp/murmura_jax_cache}"
 run() {
   local name=$1 tmo=$2; shift 2
   echo "=== $name ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
@@ -110,6 +117,61 @@ PYEOF
     exit 1
   fi
   echo "preflight profile capture clean" | tee -a "$OUT/battery.log"
+fi
+# Optional gang pre-flight (./run_tpu_battery.sh --gang [outdir]): a
+# CPU-pinned 2-seed gang (docs/PERFORMANCE.md) must (a) byte-match both
+# members' single-run histories and (b) compile exactly one program for
+# the whole gang — if gang batching breaks parity or the compile
+# amortization, the gang bench numbers below are meaningless.
+if [ "$GANG" = 1 ]; then
+  echo "=== preflight: gang parity + single compile ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 600 env JAX_PLATFORMS=cpu python - > "$OUT/preflight_gang.out" 2>&1 <<'PYEOF'
+import sys
+import yaml
+from pathlib import Path
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_gang_from_config, build_network_from_config
+from murmura_tpu.analysis.sanitizers import track_compiles
+
+raw = yaml.safe_load(Path("examples/configs/sweep_seeds.yaml").read_text())
+raw["experiment"]["rounds"] = 4
+base_seed = raw["experiment"]["seed"]
+seeds = [base_seed, base_seed + 1]
+
+gang = build_gang_from_config(Config.model_validate(raw), seeds=seeds)
+with track_compiles() as tracker:
+    histories = gang.train(rounds=4, eval_every=2, rounds_per_dispatch=4)
+    gang_compiles = tracker.total
+# The fused gang program (train + in-scan eval) must be the gang's ONE
+# compile — S members share it.
+if gang_compiles != 1:
+    print(f"gang train compiled {gang_compiles} program(s), expected exactly 1")
+    sys.exit(1)
+for i, seed in enumerate(seeds):
+    sraw = yaml.safe_load(Path("examples/configs/sweep_seeds.yaml").read_text())
+    sraw["experiment"]["rounds"] = 4
+    sraw["experiment"]["seed"] = seed
+    sraw.pop("sweep", None)
+    single = build_network_from_config(Config.model_validate(sraw)).train(
+        rounds=4, eval_every=2, rounds_per_dispatch=4
+    )
+    mismatched = [
+        k for k in single
+        if single[k] and histories[i].get(k) != single[k]
+    ]
+    if mismatched:
+        print(f"gang member seed={seed} diverged from its single run in {mismatched}")
+        print("gang:", {k: histories[i].get(k) for k in mismatched})
+        print("single:", {k: single[k] for k in mismatched})
+        sys.exit(1)
+print(f"gang parity ok for seeds {seeds}; whole gang compiled once")
+PYEOF
+  then
+    echo "preflight gang FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_gang.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight gang clean" | tee -a "$OUT/battery.log"
 fi
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py

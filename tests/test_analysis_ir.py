@@ -341,6 +341,60 @@ class TestCrashIsolation:
         )
 
 
+class TestGangContracts:
+    """MUR500/MUR501 (ISSUE 5): gang batching is IR-inert — vmapping the
+    round program over the seed axis adds no collectives and growing the
+    member count within a power-of-two bucket causes no recompile."""
+
+    def test_gang_contracts_hold(self):
+        assert ir.check_gang_round() == []
+
+    def test_broken_bucket_mapping_is_a_finding(self, monkeypatch):
+        # next_bucket degraded to identity: S=3 and S=4 gangs present
+        # different stacked shapes and the growth recompiles — exactly the
+        # drift MUR501 exists to catch.
+        from murmura_tpu.core import gang as gang_mod
+
+        monkeypatch.setattr(gang_mod, "next_bucket", lambda s: s)
+        fs = ir.check_gang_round()
+        assert any(
+            f.rule == "MUR501" and "recompiled the gang round step" in f.message
+            for f in fs
+        )
+
+    @pytest.mark.skipif(
+        len(jax.devices()) < 2, reason="needs a multi-device host"
+    )
+    def test_cross_member_communication_is_a_finding(self, monkeypatch):
+        # A gang program that mixes members — a roll along the sharded seed
+        # axis lowers to a collective-permute absent from the single run —
+        # must surface as a stray-collective MUR500 finding.
+        from murmura_tpu.parallel import mesh as mesh_mod
+
+        real = mesh_mod.shard_gang_step
+
+        def leaky(vstep, prog, batch, mesh, donate=True):
+            def leaky_step(params, agg, keys, adj, comp, ridx, data):
+                new_params, new_agg, metrics = vstep(
+                    params, agg, keys, adj, comp, ridx, data
+                )
+                mixed = jax.tree_util.tree_map(
+                    lambda l: (0.5 * l + 0.5 * jnp.roll(l, 1, axis=0)).astype(
+                        l.dtype
+                    ),
+                    new_params,
+                )
+                return mixed, new_agg, metrics
+
+            return real(leaky_step, prog, batch, mesh, donate=donate)
+
+        monkeypatch.setattr(mesh_mod, "shard_gang_step", leaky)
+        fs = ir.check_gang_round()
+        assert any(
+            f.rule == "MUR500" and "seed axis" in f.message for f in fs
+        )
+
+
 class TestJsonOutput:
     """Satellite: `check --json` emits machine-readable JSON lines."""
 

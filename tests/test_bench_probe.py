@@ -1,0 +1,117 @@
+"""bench.py probe hardening (ISSUE 5 satellite): env-configurable timeout
+and the on-disk probe cache — successes cached with a long TTL, failed
+gauntlets with a short one (the dead-tunnel 3x60s cost is the case the
+cache exists to kill), and a cached TPU answer re-verified before being
+trusted (a tunnel death inside the TTL must not mislabel a CPU run).
+
+bench.py imports no jax at module scope, so importing it here is safe.
+"""
+
+import json
+import time
+
+import bench
+
+
+def _use_tmp_cache(monkeypatch, tmp_path):
+    path = tmp_path / "probe_cache.json"
+    monkeypatch.setattr(bench, "PROBE_CACHE_PATH", str(path))
+    return path
+
+
+class TestProbeCache:
+    def test_cached_failure_skips_the_gauntlet(self, monkeypatch, tmp_path):
+        path = _use_tmp_cache(monkeypatch, tmp_path)
+        path.write_text(json.dumps(
+            {"backend": "", "device_kind": "", "unix": time.time()}
+        ))
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda *a, **k: pytest_fail("probed despite cached failure"),
+        )
+        backend, kind, log = bench.probe_backend()
+        assert backend == "cpu-fallback" and kind == ""
+        assert log[0]["cached"] is True and log[0]["ok"] is False
+
+    def test_cached_failure_expires(self, monkeypatch, tmp_path):
+        path = _use_tmp_cache(monkeypatch, tmp_path)
+        path.write_text(json.dumps(
+            {"backend": "", "unix": time.time() - bench.PROBE_FAIL_TTL_S - 1}
+        ))
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda *a, **k: {"ok": True, "s": 0.1, "backend": "cpu",
+                             "device_kind": "cpu"},
+        )
+        backend, _, log = bench.probe_backend()
+        assert backend == "cpu"
+        assert not log[0].get("cached")
+
+    def test_cached_cpu_success_is_trusted(self, monkeypatch, tmp_path):
+        path = _use_tmp_cache(monkeypatch, tmp_path)
+        path.write_text(json.dumps(
+            {"backend": "cpu", "device_kind": "cpu", "unix": time.time()}
+        ))
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda *a, **k: pytest_fail("probed despite cached cpu"),
+        )
+        backend, kind, log = bench.probe_backend()
+        assert backend == "cpu" and kind == "cpu"
+        assert log[0]["cached"] is True
+
+    def test_cached_tpu_is_reverified_and_demoted_on_death(
+        self, monkeypatch, tmp_path
+    ):
+        # A tunnel death inside the TTL must NOT mislabel a CPU-fallback
+        # run as TPU — the cached answer gets one quick re-verify, and a
+        # failure falls through to the full gauntlet (here: 1 attempt)
+        # whose failed outcome is cached for the next invocation.
+        path = _use_tmp_cache(monkeypatch, tmp_path)
+        path.write_text(json.dumps(
+            {"backend": "tpu", "device_kind": "TPU v5e", "unix": time.time()}
+        ))
+        calls = []
+
+        def dead_probe(timeout_s):
+            calls.append(timeout_s)
+            return {"ok": False, "s": 0.1, "err": "timeout"}
+
+        monkeypatch.setattr(bench, "_probe_once", dead_probe)
+        backend, kind, log = bench.probe_backend(attempts=1, pause_s=0.0)
+        assert backend == "cpu-fallback" and kind == ""
+        assert log[0]["reverify_of_cached"] == "tpu"
+        # quick re-verify (capped) + one gauntlet attempt
+        assert len(calls) == 2 and calls[0] <= 15.0
+        assert json.loads(path.read_text())["backend"] == ""
+
+    def test_success_is_cached(self, monkeypatch, tmp_path):
+        path = _use_tmp_cache(monkeypatch, tmp_path)
+        monkeypatch.setattr(
+            bench, "_probe_once",
+            lambda *a, **k: {"ok": True, "s": 0.5, "backend": "tpu",
+                             "device_kind": "TPU v5e"},
+        )
+        backend, kind, _ = bench.probe_backend()
+        assert (backend, kind) == ("tpu", "TPU v5e")
+        rec = json.loads(path.read_text())
+        assert rec["backend"] == "tpu" and rec["device_kind"] == "TPU v5e"
+
+    def test_env_timeout_is_honored(self, monkeypatch, tmp_path):
+        _use_tmp_cache(monkeypatch, tmp_path)
+        monkeypatch.setenv("MURMURA_PROBE_TIMEOUT_S", "7.5")
+        seen = []
+
+        def probe(timeout_s):
+            seen.append(timeout_s)
+            return {"ok": True, "s": 0.1, "backend": "cpu", "device_kind": "cpu"}
+
+        monkeypatch.setattr(bench, "_probe_once", probe)
+        bench.probe_backend()
+        assert seen == [7.5]
+
+
+def pytest_fail(msg):
+    import pytest
+
+    pytest.fail(msg)

@@ -46,6 +46,22 @@ POINTS = [
      "variant": "small"},
 ]
 
+# --sparse variant (ISSUE 6): the exponential-graph edge-mask engine
+# (topology/sparse.py, exchange == "sparse") at the three scaling marks.
+# Degree is O(log N), the round program's adjacency input is [k, N], and
+# MUR600 proves no [N, N] operand in the lowering — 4096 nodes on one
+# chip is the acceptance point.  Each cell records cost{flops,bytes,mfu}
+# (bench.py's cost line) plus the analytic per-round exchange bytes.
+SPARSE_POINTS = [
+    {"nodes": 256, "algo": "krum", "exchange": "sparse"},
+    {"nodes": 1024, "algo": "krum", "exchange": "sparse",
+     "variant": "small"},
+    {"nodes": 4096, "algo": "krum", "exchange": "sparse",
+     "variant": "small"},
+    {"nodes": 4096, "algo": "fedavg", "exchange": "sparse",
+     "variant": "small"},
+]
+
 
 def run_point(
     nodes: int, algo: str, exchange: str, on_cpu: bool, variant: str = ""
@@ -79,11 +95,19 @@ def run_point(
     # the timed block to finish inside the point timeout.  Recorded in the
     # point so the artifact is self-describing.
     samples_per_node = 16 if (on_cpu and nodes >= 1024) else 64
+    sparse = exchange == "sparse"
+    if sparse:
+        # exchange == "sparse": the exponential edge-mask engine — the
+        # topology selects it; tpu.exchange is moot (factories route every
+        # SparseTopology through the sparse circulant dispatch).
+        topo_cfg = {"type": "exponential", "num_nodes": nodes}
+    else:
+        topo_cfg = {"type": "k-regular", "num_nodes": nodes, "k": 4}
     cfg = Config.model_validate(
         {
             "experiment": {"name": f"scale-{algo}-{nodes}", "seed": 7,
                            "rounds": 4},
-            "topology": {"type": "k-regular", "num_nodes": nodes, "k": 4},
+            "topology": topo_cfg,
             "aggregation": {"algorithm": algo, "params": agg_params},
             "attack": {"enabled": True, "type": "gaussian", "percentage": 0.1,
                         "params": {"noise_std": 10.0}},
@@ -102,7 +126,9 @@ def run_point(
                 "num_devices": 1,
                 "compute_dtype": "float32" if on_cpu else "bfloat16",
                 "param_dtype": "float32" if on_cpu else "bfloat16",
-                "exchange": exchange,
+                # exchange == "sparse" is selected by the topology, not
+                # this knob (any value validates; the sparse engine wins).
+                "exchange": "allgather" if sparse else exchange,
                 # NOTE: compilation_cache_dir is deliberately NOT set here —
                 # the AOT compile below must measure the compiler cold, and
                 # a cache enabled at build time keeps serving disk hits no
@@ -199,6 +225,32 @@ def run_point(
     warmup_s = block()
     rounds_per_sec = timed / block()
 
+    cost = None
+    if sparse:
+        # The bench.py cost line, per sparse cell: XLA's AOT cost model of
+        # the per-round step (flops, bytes; the lower+compile is a cache
+        # hit for timed == 1 and a one-off small compile otherwise), MFU
+        # against the chip's peak, and the analytic per-round exchange
+        # bytes (degree x N x P x itemsize — what actually travels,
+        # O(N log N), vs the dense modes' O(N^2) mask alone).
+        from bench import _peak_flops
+
+        c = network.step_cost_analysis()
+        flops = float(c.get("flops", 0.0)) or None
+        device_kind = getattr(jax.local_devices()[0], "device_kind", "cpu")
+        peak = _peak_flops(device_kind)
+        cost = {
+            "flops": flops,
+            "bytes": float(c.get("bytes accessed", 0.0)) or None,
+            "mfu": (
+                round(flops * rounds_per_sec / peak, 6)
+                if flops and peak else None
+            ),
+        }
+        itemsize = 2 if cfg.tpu.param_dtype == "bfloat16" else 4
+        degree = len(network.topology.offsets)
+        exchange_bytes = degree * nodes * int(network.program.model_dim) * itemsize
+
     mem = {}
     stats = jax.local_devices()[0].memory_stats() or {}
     if "peak_bytes_in_use" in stats:
@@ -225,6 +277,9 @@ def run_point(
         "timed_rounds_per_block": timed,
         "samples_per_node": samples_per_node,
         "model_dim": int(network.program.model_dim),
+        **({"cost": cost,
+            "degree": degree,
+            "exchange_bytes_per_round": exchange_bytes} if sparse else {}),
         **mem,
     }))
 
@@ -236,10 +291,18 @@ def main():
     ap.add_argument("--variant", default="",
                     help="internal: model variant override for --point")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--sparse", action="store_true",
+                    help="run the exponential-graph sparse-exchange cells "
+                         "(N in {256, 1024, 4096}) instead of the dense/"
+                         "circulant grid; writes bench_scaling_sparse.json")
     ap.add_argument("--timeout", type=float, default=1800.0)
-    ap.add_argument("--out", default=str(Path(__file__).parent /
-                                          "bench_scaling.json"))
+    ap.add_argument("--out", default=None)
     args = ap.parse_args()
+    if args.out is None:
+        args.out = str(Path(__file__).parent / (
+            "bench_scaling_sparse.json" if args.sparse else
+            "bench_scaling.json"
+        ))
 
     if args.point:
         run_point(int(args.point[0]), args.point[1], args.point[2], args.cpu,
@@ -266,7 +329,7 @@ def main():
         Path(args.out).write_text(json.dumps(blob, indent=2) + "\n")
         return blob
 
-    for p in POINTS:
+    for p in (SPARSE_POINTS if args.sparse else POINTS):
         cmd = [sys.executable, __file__, "--point", str(p["nodes"]),
                p["algo"], p["exchange"]]
         if p.get("variant"):

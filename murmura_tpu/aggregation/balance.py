@@ -16,6 +16,7 @@ from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     blend_with_own,
+    circulant_in_degree,
     circulant_masked_mean,
     circulant_neighbor_distances,
     masked_neighbor_mean,
@@ -60,9 +61,12 @@ def make_balance(
     alpha: float = 0.5,
     min_neighbors: int = 1,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         lambda_t = round_idx / jnp.maximum(1, ctx.total_rounds)
@@ -74,16 +78,40 @@ def make_balance(
             # thresholding, closest-fallback, and the accepted mean all over
             # k rolled copies instead of [N, N] tensors.
             d_k = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
-            accept_k = d_k <= threshold[None, :]
-            count = accept_k.sum(axis=0)
-            closest = jnp.argmin(d_k, axis=0)  # offset index per node
-            fallback = (count < min_neighbors)[None, :] & (
-                jnp.arange(len(offsets))[:, None] == closest[None, :]
-            )
+            if sparse_exchange:
+                # Sparse exchange mode: ``adj`` is the [k, N] edge mask —
+                # inactive edges are excluded from acceptance, the closest-
+                # neighbor fallback, and the degree normalizer (all-ones
+                # masks reproduce the static circulant path bit-for-bit).
+                edge_b = adj > 0
+                accept_k = edge_b & (d_k <= threshold[None, :])
+                count = accept_k.sum(axis=0)
+                closest = jnp.argmin(
+                    jnp.where(edge_b, d_k, jnp.inf), axis=0
+                )
+                has_any = edge_b.any(axis=0)
+                fallback = (
+                    ((count < min_neighbors) & has_any)[None, :]
+                    & (
+                        jnp.arange(len(offsets))[:, None]
+                        == closest[None, :]
+                    )
+                    & edge_b
+                )
+                degree = jnp.maximum(adj.sum(axis=0), 1.0).astype(own.dtype)
+            else:
+                accept_k = d_k <= threshold[None, :]
+                count = accept_k.sum(axis=0)
+                closest = jnp.argmin(d_k, axis=0)  # offset index per node
+                fallback = (count < min_neighbors)[None, :] & (
+                    jnp.arange(len(offsets))[:, None] == closest[None, :]
+                )
+                degree = jnp.full(
+                    (own.shape[0],), float(len(offsets)), own.dtype
+                )
             accept_k = (accept_k | fallback).astype(own.dtype)
             neighbor_avg = circulant_masked_mean(bcast, accept_k, offsets)
             accepted_count = accept_k.sum(axis=0)
-            degree = jnp.full((own.shape[0],), float(len(offsets)), own.dtype)
             if ctx.audit:
                 # Sender-side taps via rolls only (ppermute-clean, MUR400):
                 # accept_k[o_idx, i] = receiver i accepted its neighbor at
@@ -92,9 +120,12 @@ def make_balance(
                     jnp.roll(accept_k[i].astype(jnp.float32), o)
                     for i, o in enumerate(offsets)
                 )
-                tap_considered_by = jnp.full(
-                    (own.shape[0],), float(len(offsets))
-                )
+                if sparse_exchange:
+                    tap_considered_by = circulant_in_degree(adj, offsets)
+                else:
+                    tap_considered_by = jnp.full(
+                        (own.shape[0],), float(len(offsets))
+                    )
         else:
             dist = pairwise_l2_distances(own, bcast)
             accepted = accept_with_closest_fallback(

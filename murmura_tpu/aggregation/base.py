@@ -125,13 +125,22 @@ class AggregatorDef:
     state_kind: Dict[str, str] = field(default_factory=dict)
     collectives: Optional[Mapping[str, Collection[str]]] = None
 
-    def declared_collectives(self, circulant: bool) -> Optional[FrozenSet[str]]:
+    def declared_collectives(self, circulant) -> Optional[FrozenSet[str]]:
         """Allowed collective set for one exchange mode (``None`` =
         undeclared).  The hook the IR analyzer calls; values must be drawn
-        from :data:`COLLECTIVE_NAMES`."""
+        from :data:`COLLECTIVE_NAMES`.  ``circulant`` is the legacy bool
+        (dense/circulant) or a mode string; mode ``"sparse"`` (the [k, N]
+        edge-mask engine) inherits the circulant declaration unless a rule
+        declares a tighter ``"sparse"`` set — the sparse path IS the
+        circulant machinery with mask weights (MUR601)."""
         if self.collectives is None:
             return None
-        mode = "circulant" if circulant else "dense"
+        if isinstance(circulant, str):
+            mode = circulant
+        else:
+            mode = "circulant" if circulant else "dense"
+        if mode == "sparse" and "sparse" not in self.collectives:
+            mode = "circulant"
         return frozenset(self.collectives.get(mode, ()))
 
 
@@ -416,6 +425,21 @@ def circulant_masked_mean(
     cnt = accept_k.sum(axis=0)
     w_norm = accept_k / jnp.maximum(cnt, 1e-12)[None, :]
     return circulant_weighted_sum(bcast, w_norm, offsets, out_dtype=bcast.dtype)
+
+
+def circulant_in_degree(edge_k: jnp.ndarray, offsets) -> jnp.ndarray:
+    """[N] sender in-degree under a [k, N] edge mask, via rolls only.
+
+    ``edge_k[j, i]`` says receiver ``i`` reads sender ``(i + offsets[j])
+    % N``, so sender ``s`` is read by receiver ``(s - o) % N`` — each term
+    is one roll of a [N] row, which lowers to boundary ppermutes on a
+    sharded node axis (the tap/degree helper of the sparse exchange mode;
+    keeps MUR400/MUR601 inventories ppermute-only).
+    """
+    return sum(
+        jnp.roll(edge_k[j].astype(jnp.float32), o)
+        for j, o in enumerate(offsets)
+    )
 
 
 def candidate_indices(adj: jnp.ndarray, m_cap: int):

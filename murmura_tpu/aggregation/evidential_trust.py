@@ -40,6 +40,7 @@ import numpy as np
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    circulant_in_degree,
     circulant_weighted_sum,
     masked_neighbor_mean,
 )
@@ -66,9 +67,12 @@ def make_evidential_trust(
     strength_guard: bool = True,
     strength_guard_factor: float = 10.0,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def init_state(num_nodes: int):
         return {
@@ -125,6 +129,14 @@ def make_evidential_trust(
             )
             trust_new = jnp.where(inflated | ~finite, 0.0, trust_new)
 
+        # Sparse exchange mode: ``adj`` is the [k, N] edge mask — inactive
+        # edges contribute no trust observation (state untouched), cannot
+        # be accepted, and drop out of every masked statistic.  The [N, N]
+        # smoothed-trust state keeps its dense layout (it is carried
+        # aggregation state, O(N^2) *memory* but indexed O(k·N) per round;
+        # documented exception to the MUR600 no-dense-operand set).
+        edge_b = adj > 0 if sparse_exchange else None
+
         if use_adaptive_trust:
             seen = state["trust_seen"][rows, cols]  # [k, N]
             smoothed = (
@@ -132,16 +144,29 @@ def make_evidential_trust(
                 + (1.0 - trust_momentum) * state["smoothed_trust"][rows, cols]
             )
             trust = jnp.where(seen > 0, smoothed, trust_new)
-            new_state = {
-                "smoothed_trust": state["smoothed_trust"].at[rows, cols].set(trust),
-                "trust_seen": state["trust_seen"].at[rows, cols].set(1.0),
-            }
+            if sparse_exchange:
+                old_t = state["smoothed_trust"][rows, cols]
+                new_state = {
+                    "smoothed_trust": state["smoothed_trust"]
+                    .at[rows, cols]
+                    .set(jnp.where(edge_b, trust, old_t)),
+                    "trust_seen": state["trust_seen"]
+                    .at[rows, cols]
+                    .set(jnp.where(edge_b, 1.0, seen)),
+                }
+            else:
+                new_state = {
+                    "smoothed_trust": state["smoothed_trust"].at[rows, cols].set(trust),
+                    "trust_seen": state["trust_seen"].at[rows, cols].set(1.0),
+                }
         else:
             trust = trust_new
             new_state = state
 
         current_threshold = _current_threshold(round_idx, ctx.total_rounds)
         accepted = trust >= current_threshold  # [k, N]
+        if sparse_exchange:
+            accepted = accepted & edge_b
         weights = jnp.where(accepted, trust, 0.0)
         total = weights.sum(axis=0)
         has_accepted = total > 0
@@ -156,13 +181,32 @@ def make_evidential_trust(
         blended = self_weight * own + (1.0 - self_weight) * neighbor_agg
         new_flat = jnp.where(has_accepted[:, None], blended, own)
 
-        stats = {
-            "acceptance_rate": accepted.sum(axis=0) / float(k),
-            "mean_trust": trust.mean(axis=0),
-            "mean_vacuity": vacuity.mean(axis=0),
-            "mean_entropy": metrics["entropy"].mean(axis=0),
-            "threshold": jnp.broadcast_to(current_threshold, (n,)),
-        }
+        if sparse_exchange:
+            edge_w = adj.astype(jnp.float32)
+            deg = jnp.maximum(edge_w.sum(axis=0), 1.0)
+            # Reduce through .mean + a k/deg rescale rather than a
+            # multiply-sum: with an all-active mask the rescale is exactly
+            # 1.0, so the stat is bit-identical to the static circulant
+            # path's .mean(axis=0) (a fused multiply-sum accumulates in a
+            # different order and drifts by an ulp).
+            masked_mean = lambda m: (  # noqa: E731
+                jnp.where(edge_b, m, 0.0).mean(axis=0) * (float(k) / deg)
+            )
+            stats = {
+                "acceptance_rate": accepted.sum(axis=0) / deg,
+                "mean_trust": masked_mean(trust),
+                "mean_vacuity": masked_mean(vacuity),
+                "mean_entropy": masked_mean(metrics["entropy"]),
+                "threshold": jnp.broadcast_to(current_threshold, (n,)),
+            }
+        else:
+            stats = {
+                "acceptance_rate": accepted.sum(axis=0) / float(k),
+                "mean_trust": trust.mean(axis=0),
+                "mean_vacuity": vacuity.mean(axis=0),
+                "mean_entropy": metrics["entropy"].mean(axis=0),
+                "threshold": jnp.broadcast_to(current_threshold, (n,)),
+            }
         if ctx.audit:
             # Sender-side taps via rolls only (ppermute stays the only
             # roll-added collective — MUR400): trust[o_idx, i] is receiver
@@ -171,11 +215,24 @@ def make_evidential_trust(
                 jnp.roll(accepted[i].astype(jnp.float32), o)
                 for i, o in enumerate(offsets)
             )
-            stats["tap_considered_by"] = jnp.full((n,), float(k))
-            stats["tap_trust_received"] = sum(
-                jnp.roll(trust[i].astype(jnp.float32), o)
-                for i, o in enumerate(offsets)
-            ) / float(k)
+            if sparse_exchange:
+                in_deg = circulant_in_degree(adj, offsets)
+                stats["tap_considered_by"] = in_deg
+                stats["tap_trust_received"] = sum(
+                    jnp.roll(
+                        (trust * adj.astype(trust.dtype))[i].astype(
+                            jnp.float32
+                        ),
+                        o,
+                    )
+                    for i, o in enumerate(offsets)
+                ) / jnp.maximum(in_deg, 1.0)
+            else:
+                stats["tap_considered_by"] = jnp.full((n,), float(k))
+                stats["tap_trust_received"] = sum(
+                    jnp.roll(trust[i].astype(jnp.float32), o)
+                    for i, o in enumerate(offsets)
+                ) / float(k)
         return new_flat, new_state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):

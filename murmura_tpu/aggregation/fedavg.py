@@ -23,12 +23,25 @@ from murmura_tpu.aggregation.base import (
 
 
 def make_fedavg(
-    exchange_offsets: Optional[Sequence[int]] = None, **_params
+    exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
+    **_params,
 ) -> AggregatorDef:
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
-        degree = adj.sum(axis=1)
+        if sparse_exchange:
+            # Sparse exchange mode (topology/sparse.py): ``adj`` is the
+            # [k, N] per-offset edge mask, never [N, N]; its rows weight
+            # the rolled neighbor sum directly, so inactive edges (one_peer
+            # rounds, fault-dropped links) contribute nothing.  An all-ones
+            # mask reproduces the circulant path bit-for-bit (1.0 * x is
+            # exact).
+            degree = adj.sum(axis=0)
+        else:
+            degree = adj.sum(axis=1)
         if offsets is not None:
             # roll(bcast, -o)[i] == bcast[(i+o) % N]: node i's neighbor at
             # circulant offset o; the shared kernel chunks P at large N*P.
@@ -36,9 +49,12 @@ def make_fedavg(
             # (matching the dense branch's preferred_element_type) while
             # out_dtype keeps the stored sum — and any chunked [N, P]
             # buffer — in the resident param dtype.
-            ones = jnp.ones((len(offsets), own.shape[0]), jnp.float32)
+            if sparse_exchange:
+                w_k = adj.astype(jnp.float32)
+            else:
+                w_k = jnp.ones((len(offsets), own.shape[0]), jnp.float32)
             neighbor_sum = circulant_weighted_sum(
-                bcast, ones, offsets, out_dtype=own.dtype
+                bcast, w_k, offsets, out_dtype=own.dtype
             )
         else:
             # bf16 operands with f32 accumulation (MXU-native); an f32 adj

@@ -32,6 +32,7 @@ from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     candidate_indices,
+    circulant_in_degree,
     circulant_masked_mean,
     circulant_neighbor_distances,
     pairwise_l2_distances,
@@ -42,11 +43,14 @@ def make_krum(
     num_compromised: int = 0,
     max_candidates: int = None,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     c = int(num_compromised)
     mc = None if max_candidates is None else int(max_candidates)
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
         """O(degree) Krum for circulant graphs (tpu.exchange: ppermute).
@@ -69,6 +73,12 @@ def make_krum(
         # a traced fallback.  Scores are computed either way so the
         # krum_score stat matches the dense path's (which reports the
         # argmin score even when the constraint forces the own state).
+        # Sparse exchange mode: ``adj`` is the [k, N] edge mask, the
+        # candidate count varies per node (one_peer schedules, fault-
+        # dropped links), so validity/constraint/trim depth become traced
+        # per-node values — with an all-ones mask every formula below
+        # reduces bit-exactly to the static circulant path (appending
+        # +0.0 terms and where(True, ...) selections are exact).
         ok = c < (m - 2) / 2
 
         own_d = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
@@ -95,14 +105,35 @@ def make_krum(
             rows.append(jnp.stack(cols))
         pair = jnp.stack(rows)  # [m, m, N]
 
-        num_closest = max(1, m - c - 2)
-        ranked = jnp.sort(pair, axis=1)
-        scores = ranked[:, :num_closest, :].sum(axis=1)  # [m, N]
-        w = jnp.argmin(scores, axis=0)  # [N] candidate position
-        best = jnp.min(scores, axis=0)
+        if sparse_exchange:
+            valid = jnp.concatenate(
+                [jnp.ones((1, n), adj.dtype), adj], axis=0
+            ) > 0  # [m, N]: self always a candidate
+            m_i = valid.sum(axis=0)  # [N] traced candidate counts
+            pair_valid = valid[:, None, :] & valid[None, :, :]
+            masked = jnp.where(pair_valid, pair, jnp.inf)
+            num_closest = jnp.maximum(1, m_i - c - 2)  # [N]
+            ranked = jnp.sort(masked, axis=1)
+            take = (
+                jnp.arange(m)[None, :, None] < num_closest[None, None, :]
+            )
+            scores = jnp.where(
+                take & jnp.isfinite(ranked), ranked, 0.0
+            ).sum(axis=1)  # [m, N]
+            scores = jnp.where(valid, scores, jnp.inf)
+            w = jnp.argmin(scores, axis=0)
+            best = jnp.min(scores, axis=0)
+            # Per-node constraint: too few candidates => own state.
+            w = jnp.where(c < (m_i - 2) / 2, w, 0)
+        else:
+            num_closest = max(1, m - c - 2)
+            ranked = jnp.sort(pair, axis=1)
+            scores = ranked[:, :num_closest, :].sum(axis=1)  # [m, N]
+            w = jnp.argmin(scores, axis=0)  # [N] candidate position
+            best = jnp.min(scores, axis=0)
 
-        if not ok:
-            w = jnp.zeros((n,), w.dtype)  # every node keeps its own state
+            if not ok:
+                w = jnp.zeros((n,), w.dtype)  # every node keeps own state
         accept_k = (w[None, :] == jnp.arange(1, m)[:, None]).astype(own.dtype)
         neighbor_sel = circulant_masked_mean(bcast, accept_k, offsets)
         selected_own = w == 0
@@ -123,7 +154,12 @@ def make_krum(
                 jnp.roll(accept_k[i].astype(jnp.float32), o)
                 for i, o in enumerate(offsets)
             )
-            stats["tap_considered_by"] = jnp.full((n,), float(len(offsets)))
+            if sparse_exchange:
+                stats["tap_considered_by"] = circulant_in_degree(adj, offsets)
+            else:
+                stats["tap_considered_by"] = jnp.full(
+                    (n,), float(len(offsets))
+                )
         return new_flat, state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):

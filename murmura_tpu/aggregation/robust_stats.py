@@ -65,6 +65,7 @@ def _dense_candidate_map(own, bcast, adj, m_cap, fn):
 def make_coordinate_median(
     max_candidates: Optional[int] = None,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     """Coordinate-wise median over own + neighbor states.
@@ -80,6 +81,8 @@ def make_coordinate_median(
     offsets = (
         None if exchange_offsets is None else [int(o) for o in exchange_offsets]
     )
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
@@ -109,6 +112,36 @@ def make_coordinate_median(
         n = own.shape[0]
         m = len(offsets) + 1
 
+        if sparse_exchange:
+            # Sparse exchange mode: ``adj`` is the [k, N] edge mask;
+            # masked-out candidates inf-pad to the END of the sort and the
+            # median indices address only the first cnt valid rows (the
+            # dense path's formula over the circulant stack).  All-ones
+            # masks reproduce the static path bit-for-bit.
+            valid = jnp.concatenate(
+                [jnp.ones((1, n), adj.dtype), adj], axis=0
+            ) > 0  # [m, N]
+            cnt = valid.sum(axis=0)  # [N] >= 1 (self always valid)
+
+            def coord_median(cand):  # [m, N, c] -> [N, c]
+                ranked = jnp.sort(
+                    jnp.where(valid[:, :, None], cand, jnp.inf), axis=0
+                )
+                lo = jnp.take_along_axis(
+                    ranked, ((cnt - 1) // 2)[None, :, None], axis=0
+                )
+                hi = jnp.take_along_axis(
+                    ranked, (cnt // 2)[None, :, None], axis=0
+                )
+                return (0.5 * (lo + hi))[0]
+
+            new_flat = circulant_candidate_map(
+                own, bcast, offsets, coord_median
+            )
+            return new_flat, state, {
+                "num_candidates": cnt.astype(jnp.float32)
+            }
+
         def coord_median(cand):  # [m, N, c] -> [N, c], all candidates valid
             ranked = jnp.sort(cand, axis=0)
             return 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
@@ -134,6 +167,7 @@ def make_trimmed_mean(
     trim_ratio: float = 0.2,
     max_candidates: Optional[int] = None,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     """Coordinate-wise beta-trimmed mean: drop the floor(beta*cnt) smallest
@@ -151,6 +185,8 @@ def make_trimmed_mean(
     offsets = (
         None if exchange_offsets is None else [int(o) for o in exchange_offsets]
     )
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
@@ -183,6 +219,39 @@ def make_trimmed_mean(
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
         m = len(offsets) + 1
+
+        if sparse_exchange:
+            # Sparse exchange mode: per-node candidate counts / trim depth
+            # become traced values from the [k, N] edge mask (the dense
+            # path's keep-window formula over the circulant stack); an
+            # all-ones mask reproduces the static slice bit-for-bit (the
+            # zero-padded sum and the /denom match mean(axis=0) exactly).
+            valid = jnp.concatenate(
+                [jnp.ones((1, n), adj.dtype), adj], axis=0
+            ) > 0  # [m, N]
+            cnt = valid.sum(axis=0)  # [N]
+            trim_i = jnp.floor(beta * cnt).astype(cnt.dtype)  # [N]
+
+            def coord_trimmed(cand):  # [m, N, c] -> [N, c]
+                ranked = jnp.sort(
+                    jnp.where(valid[:, :, None], cand, jnp.inf), axis=0
+                )
+                pos = jnp.arange(m)[:, None, None]  # [m, 1, 1]
+                keep = (pos >= trim_i[None, :, None]) & (
+                    pos < (cnt - trim_i)[None, :, None]
+                )
+                kept = jnp.where(keep, ranked, 0.0).sum(axis=0)
+                denom = jnp.maximum(cnt - 2 * trim_i, 1)[:, None]
+                return kept / denom.astype(kept.dtype)
+
+            new_flat = circulant_candidate_map(
+                own, bcast, offsets, coord_trimmed
+            )
+            return new_flat, state, {
+                "num_candidates": cnt.astype(jnp.float32),
+                "trimmed_per_side": trim_i.astype(jnp.float32),
+            }
+
         trim = int(beta * m)  # static: every node has exactly m candidates
 
         def coord_trimmed(cand):  # [m, N, c] -> [N, c]
@@ -212,6 +281,7 @@ def make_geometric_median(
     smoothing: float = 1e-6,
     max_candidates: Optional[int] = None,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     """Geometric median via smoothed Weiszfeld iterations (RFA,
@@ -250,6 +320,8 @@ def make_geometric_median(
     offsets = (
         None if exchange_offsets is None else [int(o) for o in exchange_offsets]
     )
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         from jax import lax
@@ -342,6 +414,11 @@ def make_geometric_median(
 
         n = own.shape[0]
         k = len(offsets)
+        # Sparse exchange mode: the [k, N] edge mask multiplies the
+        # Weiszfeld weights, so masked-out candidates carry zero weight in
+        # every recursion step (an all-ones mask is bit-exact: 1.0 / x ==
+        # 1.0 / x and 1.0 * x == x).
+        edge_w = adj.astype(jnp.float32) if sparse_exchange else None
 
         def weighted_mean(w_self, w_k):
             # circulant_weighted_sum promotes each w*roll product to f32
@@ -369,24 +446,35 @@ def make_geometric_median(
             d_k = circulant_neighbor_distances(z, bcast, offsets)  # [k, N]
             return d_self, d_k
 
-        ones_k = jnp.ones((k, n), jnp.float32)
+        ones_k = edge_w if sparse_exchange else jnp.ones((k, n), jnp.float32)
         z0 = weighted_mean(jnp.ones((n,), jnp.float32), ones_k)
+
+        def neighbor_weights(d_k):
+            if sparse_exchange:
+                return edge_w / jnp.maximum(d_k, nu)
+            return 1.0 / jnp.maximum(d_k, nu)
 
         def body(_, z):
             d_self, d_k = distances(z)
             return weighted_mean(
-                1.0 / jnp.maximum(d_self, nu), 1.0 / jnp.maximum(d_k, nu)
+                1.0 / jnp.maximum(d_self, nu), neighbor_weights(d_k)
             )
 
         z = lax.fori_loop(0, iters, body, z0)
         d_self, d_k = distances(z)
         w_self = 1.0 / jnp.maximum(d_self, nu)
-        w_k = 1.0 / jnp.maximum(d_k, nu)
+        w_k = neighbor_weights(d_k)
         tot = jnp.maximum(w_self + w_k.sum(axis=0), 1e-30)
+        if sparse_exchange:
+            cnt = 1.0 + edge_w.sum(axis=0)
+            mean_dist = (d_self + (d_k * edge_w).sum(axis=0)) / cnt
+        else:
+            cnt = jnp.full((n,), float(k + 1), jnp.float32)
+            mean_dist = (d_self + d_k.sum(axis=0)) / float(k + 1)
         stats = {
-            "num_candidates": jnp.full((n,), float(k + 1), jnp.float32),
+            "num_candidates": cnt,
             "max_weight_share": jnp.maximum(w_self, w_k.max(axis=0)) / tot,
-            "mean_dist_to_gm": (d_self + d_k.sum(axis=0)) / float(k + 1),
+            "mean_dist_to_gm": mean_dist,
         }
         return z.astype(own.dtype), state, stats
 

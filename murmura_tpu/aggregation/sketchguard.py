@@ -21,6 +21,7 @@ from murmura_tpu.aggregation.base import (
     AggregatorDef,
     blend_with_own,
     circulant_masked_mean,
+    circulant_neighbor_distances,
     masked_neighbor_mean,
     pairwise_l2_distances,
 )
@@ -37,12 +38,15 @@ def make_sketchguard(
     network_seed: int = 42,
     attack_detection_window: int = 5,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     hash_np, sign_np = make_sketch_tables(model_dim, sketch_size, network_seed)
     hash_table = jnp.asarray(hash_np)
     sign_table = jnp.asarray(sign_np)
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     # The reference keeps a deque(maxlen=attack_detection_window) of
     # acceptance rates but its threshold logic only reads the last 3
@@ -62,7 +66,6 @@ def make_sketchguard(
         own_sk = jax.vmap(sketch_one)(own)
         bcast_sk = jax.vmap(sketch_one)(bcast)
 
-        sk_dist = pairwise_l2_distances(own_sk, bcast_sk)
         own_sk_norm = jnp.sqrt(jnp.sum(own_sk * own_sk, axis=-1))
 
         lambda_t = round_idx / jnp.maximum(1, ctx.total_rounds)
@@ -75,6 +78,51 @@ def make_sketchguard(
         attack_factor = jnp.where(window_active & (recent < 0.3), 1.5, 1.0)
         threshold = time_factor * attack_factor * own_sk_norm
 
+        if sparse_exchange:
+            # Sparse exchange mode: the distance filter itself runs in
+            # *circulant* sketch space — [k, N] per-offset sketch distances
+            # via rolls instead of the [N, N] pairwise matrix — so nothing
+            # O(N^2) is ever materialized and the whole rule stays
+            # ppermute-only (the 'sparse' collectives declaration below).
+            # The direct elementwise norm differs from the Gram-identity
+            # path in f32 rounding, so sparse-vs-circulant parity for this
+            # rule is allclose, not byte-exact.
+            edge_b = adj > 0  # [k, N]
+            d_k = circulant_neighbor_distances(
+                own_sk, bcast_sk, offsets
+            )  # [k, N]
+            accept_k_b = edge_b & (d_k <= threshold[None, :])
+            count = accept_k_b.sum(axis=0)
+            closest = jnp.argmin(jnp.where(edge_b, d_k, jnp.inf), axis=0)
+            has_any = edge_b.any(axis=0)
+            fallback = (
+                ((count < min_neighbors) & has_any)[None, :]
+                & (jnp.arange(len(offsets))[:, None] == closest[None, :])
+                & edge_b
+            )
+            accept_k = (accept_k_b | fallback).astype(own.dtype)
+            neighbor_avg = circulant_masked_mean(bcast, accept_k, offsets)
+            has_accepted = accept_k.sum(axis=0) > 0
+            new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
+
+            degree = jnp.maximum(adj.sum(axis=0), 1.0)
+            acc_rate = accept_k.sum(axis=0) / degree
+            new_state = {
+                "acc_window": jnp.concatenate(
+                    [state["acc_window"][:, 1:], acc_rate[:, None]], axis=1
+                ),
+                "window_len": jnp.minimum(state["window_len"] + 1, window),
+            }
+            stats = {
+                "acceptance_rate": acc_rate,
+                "threshold": threshold,
+                "compression_ratio": jnp.full(
+                    (own.shape[0],), model_dim / sketch_size, dtype=own.dtype
+                ),
+            }
+            return new_flat, new_state, stats
+
+        sk_dist = pairwise_l2_distances(own_sk, bcast_sk)
         accepted = accept_with_closest_fallback(sk_dist, adj, threshold, min_neighbors)
 
         if offsets is not None:
@@ -119,8 +167,11 @@ def make_sketchguard(
         # MUR202: the distance filter runs in dense *sketch* space ([N, S],
         # S << P) by design, so even the circulant mode gathers/reduces the
         # small sketches — only the heavy [N, P] mean must stay ppermute.
+        # The sparse mode filters in *circulant* sketch space instead
+        # (rolled per-offset distances), so it is ppermute-only (MUR601).
         collectives={
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"all_gather", "all_reduce", "ppermute"},
+            "sparse": {"ppermute"},
         },
     )

@@ -20,6 +20,7 @@ from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
     blend_with_own,
+    circulant_in_degree,
     circulant_masked_mean,
     circulant_neighbor_distances,
     masked_neighbor_mean,
@@ -39,9 +40,12 @@ def make_ubar(
     alpha: float = 0.5,
     min_neighbors: int = 1,
     exchange_offsets: Optional[Sequence[int]] = None,
+    sparse_exchange: bool = False,
     **_params,
 ) -> AggregatorDef:
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
+    if sparse_exchange and offsets is None:
+        raise ValueError("sparse_exchange requires exchange_offsets")
 
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
         """O(degree) path (tpu.exchange: ppermute): distances, the stage-2
@@ -50,14 +54,26 @@ def make_ubar(
         n = own.shape[0]
         k = len(offsets)
 
-        # Stage 1: rho * k closest of the k circulant neighbors (degree is
-        # the compile-time constant k here).
+        # Stage 1: rho * degree closest neighbors.  On the static circulant
+        # path the degree is the compile-time constant k; in sparse
+        # exchange mode ``adj`` is the [k, N] edge mask and the per-node
+        # degree (and therefore the shortlist size) is a traced value —
+        # the floor runs in f32 instead of Python float, which agrees with
+        # int(rho * k) for every non-pathological (rho, k).
         d_nk = circulant_neighbor_distances(own, bcast, offsets).T  # [N, k]
-        num_select = max(min_neighbors, int(rho * k))
-        shortlist = rank_mask(
-            d_nk, jnp.ones_like(d_nk, dtype=bool),
-            jnp.full((n,), num_select, jnp.int32),
-        )  # [N, k]
+        if sparse_exchange:
+            edge_b = adj.T > 0  # [N, k] receiver-side active-edge mask
+            deg = adj.sum(axis=0)  # [N]
+            num_select = jnp.maximum(
+                min_neighbors, jnp.floor(rho * deg).astype(jnp.int32)
+            )
+            shortlist = rank_mask(d_nk, edge_b, num_select)  # [N, k]
+        else:
+            num_select = max(min_neighbors, int(rho * k))
+            shortlist = rank_mask(
+                d_nk, jnp.ones_like(d_nk, dtype=bool),
+                jnp.full((n,), num_select, jnp.int32),
+            )  # [N, k]
 
         # Stage 2: loss probe per offset.
         losses = circulant_probe_eval(bcast, offsets, ctx, ce_loss_metric)[
@@ -81,8 +97,11 @@ def make_ubar(
         new_flat = blend_with_own(own, neighbor_avg, has_accepted, alpha)
 
         shortlist_count = jnp.maximum(shortlist.sum(axis=1).astype(own.dtype), 1.0)
+        stage1_denom = (
+            jnp.maximum(deg, 1.0) if sparse_exchange else float(k)
+        )
         stats = {
-            "stage1_acceptance_rate": shortlist.sum(axis=1) / float(k),
+            "stage1_acceptance_rate": shortlist.sum(axis=1) / stage1_denom,
             "stage2_acceptance_rate": accepted.sum(axis=1) / shortlist_count,
             "own_loss": own_loss,
         }
@@ -93,7 +112,12 @@ def make_ubar(
                 jnp.roll(accepted[:, i].astype(jnp.float32), o)
                 for i, o in enumerate(offsets)
             )
-            stats["tap_considered_by"] = jnp.full((own.shape[0],), float(k))
+            if sparse_exchange:
+                stats["tap_considered_by"] = circulant_in_degree(adj, offsets)
+            else:
+                stats["tap_considered_by"] = jnp.full(
+                    (own.shape[0],), float(k)
+                )
         return new_flat, state, stats
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):

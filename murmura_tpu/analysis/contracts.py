@@ -42,6 +42,10 @@ _TOPOLOGY_CASES = {
         {"num_nodes": 10, "k": 3},  # odd k bumped to 4
         {"num_nodes": 4, "k": 6},  # k >= n: fully-connected degeneration
     ],
+    # Sparse offset-list families (topology/sparse.py): non-power-of-two
+    # sizes exercise the exponential-offset dedupe/degenerate handling.
+    "exponential": [{"num_nodes": 2}, {"num_nodes": 9}, {"num_nodes": 12}],
+    "one_peer": [{"num_nodes": 5}, {"num_nodes": 8}],
 }
 
 
@@ -255,6 +259,76 @@ def check_contracts(tests_dir: Optional[Path] = None) -> List[Finding]:
                     f"FaultSchedule.masked_adjacency over the {label} "
                     f"ADDED edge weight at round {r} — fault masking may "
                     "only remove edges, never create or amplify them",
+                ))
+
+    # -- MUR602: sparse-topology + population-sampler bijections ------------
+    # The sparse families and cohort samplers span the same three layers as
+    # MUR101's registries: the runtime registry (SPARSE_TOPOLOGY_TYPES /
+    # population.sampler.SAMPLERS), the config schema enums, and the
+    # executable generator contract (a sparse type must actually return a
+    # SparseTopology with valid nonzero deduped offsets).
+    sparse_path = str(pkg / "topology" / "sparse.py")
+    sparse_imports_ok = True
+    try:
+        from murmura_tpu.population.sampler import SAMPLERS
+        from murmura_tpu.topology.generators import SPARSE_TOPOLOGY_TYPES
+        from murmura_tpu.topology.sparse import SparseTopology
+    except Exception as e:  # noqa: BLE001 — the import failure IS the finding
+        findings.append(Finding(
+            "MUR602", sparse_path, 1,
+            f"the population/sparse registries failed to import "
+            f"({type(e).__name__}: {e}) — the MUR602 bijections cannot "
+            "be checked",
+        ))
+        # No early return: the MUR401 telemetry contract below is
+        # unrelated and must still run.
+        sparse_imports_ok = False
+        SAMPLERS, SPARSE_TOPOLOGY_TYPES, SparseTopology = {}, (), None
+    sampler_path = str(pkg / "population" / "sampler.py")
+    if sparse_imports_ok:
+        findings += _sync_findings(
+            "population sampler", set(SAMPLERS),
+            _schema_enum("sampler", schema.PopulationConfig),
+            sampler_path, schema_path,
+        )
+    for name in sorted(set(SPARSE_TOPOLOGY_TYPES) - set(generators.TOPOLOGY_TYPES)):
+        findings.append(Finding(
+            "MUR602", topo_path, 1,
+            f"sparse topology '{name}' is not in TOPOLOGY_TYPES — the "
+            "MUR101/MUR103 contracts never see it",
+        ))
+    for name in SPARSE_TOPOLOGY_TYPES:
+        for nn in (6, 8):
+            try:
+                topo = generators.create_topology(name, num_nodes=nn)
+            except Exception as e:  # noqa: BLE001 — a crash IS the finding
+                findings.append(Finding(
+                    "MUR602", topo_path, 1,
+                    f"sparse topology '{name}' raised at num_nodes={nn}: "
+                    f"{type(e).__name__}: {e}",
+                ))
+                continue
+            if not isinstance(topo, SparseTopology):
+                findings.append(Finding(
+                    "MUR602", topo_path, 1,
+                    f"sparse topology '{name}' returned a "
+                    f"{type(topo).__name__} — sparse families must return "
+                    "SparseTopology (the [k, N] edge-mask engine's input "
+                    "contract)",
+                ))
+                continue
+            offs = list(topo.offsets)
+            if (
+                not offs
+                or any(not 0 < o < nn for o in offs)
+                or len(set(offs)) != len(offs)
+            ):
+                findings.append(Finding(
+                    "MUR602", sparse_path, 1,
+                    f"sparse topology '{name}' at num_nodes={nn} emitted "
+                    f"invalid offsets {offs} — offsets must be nonzero mod "
+                    "N, in-range, and deduped (self-loops/double-counting "
+                    "break every weighted circulant kernel)",
                 ))
 
     # -- MUR401: telemetry schema version carries a migration note ----------

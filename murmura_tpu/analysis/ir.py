@@ -204,8 +204,14 @@ class CanonicalProgram:
     circulant: bool
     fn: Callable
     args: Tuple
-    arg_shardings: Callable  # (node_sharding, replicated) -> pytree of args
+    # (node_sharding, replicated[, edge_sharding]) -> pytree of args; the
+    # third parameter carries the sparse [k, N] edge-mask sharding and is
+    # optional for legacy two-parameter callables.
+    arg_shardings: Callable
     agg: Any = None  # the AggregatorDef (declared_collectives hook)
+    # Sparse exchange mode: the adjacency argument is the [k, N] edge mask
+    # (topology/sparse.py) instead of the [N, N] matrix.
+    sparse: bool = False
 
 
 def build_canonical(
@@ -217,6 +223,7 @@ def build_canonical(
     params: Optional[Dict[str, Any]] = None,
     dim: Optional[int] = None,
     audit: bool = False,
+    sparse: bool = False,
 ) -> CanonicalProgram:
     """Instantiate one rule over one grid cell.
 
@@ -237,14 +244,23 @@ def build_canonical(
     if dim is None or name in _PROBE_RULES:
         dim = rule_model_dim(name)
     case = dict(AGG_CASES.get(name, {}) if params is None else params)
-    if circulant:
+    if sparse:
+        circulant = True  # sparse IS the circulant machinery, mask-weighted
+        case["exchange_offsets"] = canonical_offsets(n)
+        case["sparse_exchange"] = True
+    elif circulant:
         case["exchange_offsets"] = canonical_offsets(n)
     agg = build_aggregator(name, case, model_dim=dim, total_rounds=10)
 
     rng = np.random.default_rng(0)
     own = jnp.asarray(rng.normal(size=(n, dim)) * 0.1, dt)
     bcast = jnp.asarray(rng.normal(size=(n, dim)) * 0.1, dt)
-    adj = jnp.asarray(_canonical_adj(n, circulant))
+    if sparse:
+        # The [k, N] all-active edge mask — the sparse program's adjacency
+        # input; nothing [N, N] is built for the cell (MUR600's subject).
+        adj = jnp.ones((len(canonical_offsets(n)), n), jnp.float32)
+    else:
+        adj = jnp.asarray(_canonical_adj(n, circulant))
     ridx = jnp.asarray(0.0, jnp.float32)
     state = {k: jnp.asarray(v) for k, v in agg.init_state(n).items()}
 
@@ -281,9 +297,10 @@ def build_canonical(
 
         args = (own, bcast, adj, ridx, state, probe)
 
-        def arg_shardings(node_s, repl):
+        def arg_shardings(node_s, repl, edge_s=None):
+            adj_s = edge_s if (sparse and edge_s is not None) else node_s
             return (
-                node_s, node_s, node_s, repl,
+                node_s, node_s, adj_s, repl,
                 {k: node_s for k in state},
                 {k: node_s for k in probe},
             )
@@ -295,12 +312,13 @@ def build_canonical(
 
         args = (own, bcast, adj, ridx, state)
 
-        def arg_shardings(node_s, repl):
-            return (node_s, node_s, node_s, repl, {k: node_s for k in state})
+        def arg_shardings(node_s, repl, edge_s=None):
+            adj_s = edge_s if (sparse and edge_s is not None) else node_s
+            return (node_s, node_s, adj_s, repl, {k: node_s for k in state})
 
     return CanonicalProgram(
         name=name, n=n, dim=dim, circulant=circulant, fn=fn, args=args,
-        arg_shardings=arg_shardings, agg=agg,
+        arg_shardings=arg_shardings, agg=agg, sparse=sparse,
     )
 
 
@@ -370,7 +388,12 @@ def collective_inventory(prog: CanonicalProgram, mesh=None) -> Optional[frozense
         mesh = Mesh(np.array(devices[: max(usable)]), ("nodes",))
     node_s = NamedSharding(mesh, P("nodes"))
     repl = NamedSharding(mesh, P())
-    jitted = jax.jit(prog.fn, in_shardings=prog.arg_shardings(node_s, repl))
+    edge_s = NamedSharding(mesh, P(None, "nodes"))  # sparse [k, N] mask
+    try:
+        in_s = prog.arg_shardings(node_s, repl, edge_s)
+    except TypeError:  # legacy two-parameter callables (tests)
+        in_s = prog.arg_shardings(node_s, repl)
+    jitted = jax.jit(prog.fn, in_shardings=in_s)
     txt = jitted.lower(*prog.args).compile().as_text()
     return frozenset(_HLO_COLLECTIVES[m] for m in _COLL_RE.findall(txt))
 
@@ -928,6 +951,202 @@ def check_gang_round() -> List[Finding]:
     return findings
 
 
+# Rules whose sparse-exchange programs must be free of any [N, N]-sized
+# value (MUR600).  evidential_trust is the documented exception: its
+# carried smoothed-trust state keeps the dense [N, N] layout (indexed
+# O(k·N) per round) for checkpoint/statistics parity.
+SPARSE_DENSE_FREE: Tuple[str, ...] = (
+    "fedavg", "krum", "ubar", "median", "trimmed_mean",
+    "geometric_median", "balance", "sketchguard",
+)
+# Rules whose sparse collective inventory must EQUAL the circulant one
+# (== ppermute-only) under MUR601 — the north-star set the 4096-node
+# exponential run rides on.  The remaining SPARSE_DENSE_FREE rules are
+# trace-checked by MUR600 but skip the (expensive) sharded compile.
+SPARSE_INVENTORY_RULES: Tuple[str, ...] = ("fedavg", "krum", "ubar", "median")
+
+
+def check_sparse_exchange() -> List[Finding]:
+    """MUR600/MUR601: the sparse exchange engine is dense-free and
+    communication-clean (docs/SCALING.md).
+
+    MUR600 — no O(N²) value anywhere in a sparse-mode program: each
+    SPARSE_DENSE_FREE rule's sparse cell, plus a full sparse *round
+    program* (build_round_program(sparse_offsets=...) with faults armed),
+    is traced and every equation's avals are scanned for a shape carrying
+    the node extent on two axes.  A dense adjacency (or distance matrix)
+    reappearing in sparse mode is exactly the O(N²) ceiling the engine
+    exists to remove — at N=4096 one such f32 value is 64 MB and the Gram
+    that usually follows is the real regression.
+
+    MUR601 — sparse collective inventory == circulant inventory per rule:
+    the SPARSE_INVENTORY_RULES cells are compiled with the node axis
+    sharded (edge mask sharded on its node columns) and must lower to
+    exactly the circulant mode's collectives — boundary ppermutes only; a
+    stray all_gather means the mask plumbing gathered something global.
+    """
+    import jax.numpy as jnp
+    import numpy as np
+
+    findings: List[Finding] = []
+    n = IR_NODE_COUNTS[1]  # 12: avoids colliding with the probe batch (8)
+
+    def dense_offenders(jaxpr, extent: int):
+        hits = set()
+        for eqn in iter_eqns(jaxpr):
+            for var in list(eqn.invars) + list(eqn.outvars):
+                aval = getattr(var, "aval", None)
+                shape = tuple(getattr(aval, "shape", ()) or ())
+                if (
+                    sum(1 for d in shape if d == extent) >= 2
+                    and int(np.prod(shape or (0,))) >= extent * extent
+                ):
+                    hits.add((eqn.primitive.name, shape))
+        return sorted(hits)
+
+    # -- MUR600, rule cells --------------------------------------------------
+    for name in SPARSE_DENSE_FREE:
+        path, line = _rule_anchor(name)
+        try:
+            prog = build_canonical(name, n, "float32", sparse=True)
+            hits = dense_offenders(trace_jaxpr(prog), n)
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(Finding(
+                "MUR600", path, line,
+                f"aggregator '{name}' (sparse) crashed the dense-free "
+                f"sweep: {type(e).__name__}: {e}",
+            ))
+            continue
+        if hits:
+            findings.append(Finding(
+                "MUR600", path, line,
+                f"aggregator '{name}' (sparse) traces O(N^2) value(s) "
+                f"{hits[:4]} — the sparse exchange engine must never "
+                "materialize a node-by-node object (use [k, N] edge-mask "
+                "forms and rolls)",
+            ))
+
+    # -- MUR600, full round program -----------------------------------------
+    pkg = Path(__file__).resolve().parent.parent
+    anchor = str(pkg / "core" / "rounds.py")
+    try:
+        import jax
+
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.core.rounds import build_round_program
+        from murmura_tpu.data.base import FederatedArrays
+        from murmura_tpu.faults.schedule import FaultSpec
+        from murmura_tpu.models import make_mlp
+
+        s = 16
+        rng = np.random.default_rng(0)
+        data = FederatedArrays(
+            x=rng.normal(size=(n, s, _PROBE_IN)).astype(np.float32),
+            y=rng.integers(0, _PROBE_CLASSES, size=(n, s)).astype(np.int32),
+            mask=np.ones((n, s), np.float32),
+            num_samples=np.full((n,), s),
+            num_classes=_PROBE_CLASSES,
+        )
+        model = make_mlp(
+            input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+        )
+        offsets = tuple(canonical_offsets(n))
+        agg = build_aggregator(
+            "fedavg",
+            {"exchange_offsets": list(offsets), "sparse_exchange": True},
+            model_dim=_probe_model()[2], total_rounds=5,
+        )
+        # Faults armed: the alive/quarantine/scrub edge folds are the part
+        # of the round body most tempted to rebuild [N, N].
+        prog = build_round_program(
+            model, agg, data, total_rounds=5, batch_size=8,
+            sparse_offsets=offsets, faults=FaultSpec(),
+        )
+        args = (
+            prog.init_params,
+            {k: jnp.asarray(v) for k, v in prog.init_agg_state.items()},
+            jax.random.PRNGKey(0),
+            jnp.ones((len(offsets), n), jnp.float32),
+            jnp.zeros((n,), jnp.float32),
+            jnp.ones((n,), jnp.float32),
+            jnp.asarray(0.0, jnp.float32),
+            {k: jnp.asarray(v) for k, v in prog.data_arrays.items()},
+        )
+        hits = dense_offenders(jax.make_jaxpr(prog.train_step)(*args), n)
+        if hits:
+            findings.append(Finding(
+                "MUR600", anchor, 1,
+                f"the faulted sparse round program traces O(N^2) value(s) "
+                f"{hits[:4]} — sparse-mode adjacency folds must stay in "
+                "[k, N] edge-mask space (rolls of node flags)",
+            ))
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        findings.append(Finding(
+            "MUR600", anchor, 1,
+            f"the sparse round-program dense-free sweep crashed: "
+            f"{type(e).__name__}: {e}",
+        ))
+
+    # -- MUR601 --------------------------------------------------------------
+    # The flagship rules compare sparse vs circulant inventories; every
+    # swept rule is ALSO held to its declared_collectives("sparse") set,
+    # which is how sketchguard's tighter sparse declaration ({"ppermute"}
+    # — its sparse filter runs in circulant sketch space while its
+    # circulant mode still gathers the dense sketches) stays enforced.
+    for name in SPARSE_INVENTORY_RULES + ("sketchguard",):
+        path, line = _rule_anchor(name)
+        try:
+            sparse_prog = build_canonical(
+                name, n, "float32", sparse=True, node_axis_sharded=True
+            )
+            inv_sparse = collective_inventory(sparse_prog)
+            if name in SPARSE_INVENTORY_RULES:
+                circ_prog = build_canonical(
+                    name, n, "float32", circulant=True,
+                    node_axis_sharded=True,
+                )
+                inv_circ = collective_inventory(circ_prog)
+            else:
+                inv_circ = inv_sparse
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(Finding(
+                "MUR601", path, line,
+                f"aggregator '{name}' crashed the sparse collective "
+                f"inventory sweep: {type(e).__name__}: {e}",
+            ))
+            continue
+        if inv_sparse is None or inv_circ is None:
+            warnings.warn(
+                "murmura check --ir: fewer than 2 devices available — the "
+                "MUR601 sparse collective inventory is unobservable on "
+                "this platform (run under "
+                "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+                stacklevel=2,
+            )
+            break
+        if name in SPARSE_INVENTORY_RULES and inv_sparse != inv_circ:
+            findings.append(Finding(
+                "MUR601", path, line,
+                f"aggregator '{name}' sparse mode lowers to "
+                f"{sorted(inv_sparse)} but its circulant mode lowers to "
+                f"{sorted(inv_circ)} — the [k, N] edge-mask weighting must "
+                "not change the exchange's communication (rolls stay "
+                "boundary ppermutes; nothing gathers)",
+            ))
+        declared = sparse_prog.agg.declared_collectives("sparse")
+        stray = inv_sparse - (declared or frozenset())
+        if stray:
+            findings.append(Finding(
+                "MUR601", path, line,
+                f"aggregator '{name}' sparse mode lowers to undeclared "
+                f"collective(s) {sorted(stray)} (declared sparse set: "
+                f"{sorted(declared or ())}) — either the sparse path grew "
+                "unintended communication or its collectives declaration "
+                "is stale",
+            ))
+    return findings
+
+
 # Rules that surface per-node audit taps under telemetry.audit_taps
 # (tap_* stats).  MUR400/402 run over exactly this set; a new tapped rule
 # joins the contract by being added here.
@@ -1203,6 +1422,15 @@ def check_ir(force: bool = False) -> List[Finding]:
         findings.append(Finding(
             "MUR500", str(pkg / "core" / "gang.py"), 1,
             f"the gang-batching IR contracts crashed: "
+            f"{type(e).__name__}: {e}",
+        ))
+    try:
+        findings.extend(check_sparse_exchange())
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        pkg = Path(__file__).resolve().parent.parent
+        findings.append(Finding(
+            "MUR600", str(pkg / "core" / "rounds.py"), 1,
+            f"the sparse-exchange IR contracts crashed: "
             f"{type(e).__name__}: {e}",
         ))
 

@@ -121,11 +121,26 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         if config.telemetry.profile_rounds == 0:
             config.telemetry.profile_rounds = config.experiment.rounds
 
+    population_on = (
+        config.population is not None and config.population.enabled
+    )
+    if population_on and (resume or checkpoint_dir is not None):
+        raise click.UsageError(
+            "--checkpoint-dir/--resume are not supported with population "
+            "(cohort streaming): run state spans the host-side user bank "
+            "plus the resident cohort"
+        )
+    extra = ""
+    if population_on:
+        extra = (
+            f", population={config.population.virtual_size} virtual users "
+            f"/ {config.population.sampler} cohorts"
+        )
     console.print(
         f"[bold cyan]murmura_tpu[/bold cyan] experiment "
         f"[bold]{config.experiment.name}[/bold] "
         f"(backend={config.backend}, nodes={config.topology.num_nodes}, "
-        f"rounds={config.experiment.rounds})"
+        f"rounds={config.experiment.rounds}{extra})"
     )
     set_seed(config.experiment.seed)
 

@@ -26,11 +26,18 @@ class ExperimentConfig(_Strict):
 
 
 class TopologyConfig(_Strict):
-    """Static graph topology (reference: murmura/config/schema.py:62-70)."""
+    """Static graph topology (reference: murmura/config/schema.py:62-70).
 
-    type: Literal["ring", "fully", "erdos", "k-regular"] = Field(
-        description="Topology type"
-    )
+    ``exponential`` and ``one_peer`` are *sparse* families
+    (topology/sparse.py; docs/SCALING.md): offset-list circulants whose
+    round programs take a [k, N] edge mask instead of a dense [N, N]
+    adjacency — the large-N path (4096+ nodes on one chip)."""
+
+    type: Literal[
+        "ring", "fully", "erdos", "k-regular",
+        # Sparse offset-list families (degree O(log N), never [N, N]):
+        "exponential", "one_peer",
+    ] = Field(description="Topology type")
     num_nodes: int = Field(description="Number of nodes in the network")
     p: Optional[float] = Field(default=None, description="Edge probability (erdos)")
     k: Optional[int] = Field(default=None, description="Degree (k-regular)")
@@ -245,6 +252,84 @@ class TelemetryConfig(_Strict):
             "Rounds to capture a perfetto/xprof trace for, starting at "
             "profile_start_round (0 = no window capture; murmura run "
             "--profile sets this to the whole run when unset)"
+        ),
+    )
+
+
+class PopulationConfig(_Strict):
+    """Sampled-cohort streaming over a virtual population (murmura_tpu
+    extension; ISSUE 6 — docs/SCALING.md).
+
+    Teleportation-style sampled activation (arXiv:2501.15259): every round
+    runs over a ``topology.num_nodes``-sized *cohort* drawn from a much
+    larger virtual population.  Per-user model rows persist in a host-side
+    state bank (``population/bank.py``: memory-mapped, lazily initialized);
+    the active cohort is device-resident, and the next cohort's rows are
+    staged while the current round computes.  Cohort draws are a pure
+    function of ``(seed, draw_index)`` so distributed processes agree with
+    zero communication, and cohort membership reaches the compiled round
+    program as input *values* — one compile covers the whole population
+    (the faults-subsystem mechanism, MUR302).
+
+    Default off => byte-identical behavior to a config without this block.
+    """
+
+    enabled: bool = Field(default=False, description="Enable cohort streaming")
+    virtual_size: int = Field(
+        default=0, ge=0,
+        description="Virtual population size U (users; >= topology.num_nodes)",
+    )
+    cohort_size: Optional[int] = Field(
+        default=None,
+        description=(
+            "Resident cohort size; must equal topology.num_nodes (the "
+            "compiled round program's node axis) — present for config "
+            "legibility, defaulted from the topology when omitted"
+        ),
+    )
+    sampler: Literal["uniform", "stratified"] = Field(
+        default="uniform",
+        description=(
+            "Cohort sampler: uniform (without replacement over all users) "
+            "or stratified (the user id space is split into cohort_size "
+            "contiguous strata, one draw per stratum — every region of the "
+            "population is touched every round)"
+        ),
+    )
+    seed: int = Field(
+        default=1234,
+        description=(
+            "Cohort-draw seed; draws are a pure function of (seed, "
+            "draw_index), identical in every process"
+        ),
+    )
+    rounds_per_cohort: int = Field(
+        default=1, ge=1,
+        description="Rounds a cohort stays resident before the next swap",
+    )
+    data_binding: Literal["user", "slot"] = Field(
+        default="user",
+        description=(
+            "user: a user's data shard follows them (shard user_id mod N, "
+            "re-staged at each swap); slot: shards stay bound to cohort "
+            "slots (no data restaging — params-only streaming)"
+        ),
+    )
+    inherit: Literal["teleport", "slot_init"] = Field(
+        default="teleport",
+        description=(
+            "First-activation model for a user with no banked row: "
+            "teleport (arXiv:2501.15259) adopts the OUTGOING cohort's "
+            "trained slot model, so learning accumulates across cohorts "
+            "even when re-activation is rare; slot_init starts fresh from "
+            "the slot's seed init (isolated per-user models)"
+        ),
+    )
+    bank_dir: Optional[str] = Field(
+        default=None,
+        description=(
+            "Directory for the memory-mapped state bank (default: a "
+            "TemporaryDirectory; small populations stay in RAM)"
         ),
     )
 
@@ -559,6 +644,14 @@ class Config(_Strict):
             "identical behavior to today"
         ),
     )
+    population: Optional[PopulationConfig] = Field(
+        default=None,
+        description=(
+            "Sampled-cohort streaming over a virtual population "
+            "(docs/SCALING.md); absent or disabled => byte-identical "
+            "behavior to today"
+        ),
+    )
 
     @model_validator(mode="after")
     def _telemetry_requires_enabled(self):
@@ -625,6 +718,76 @@ class Config(_Strict):
                     f"faults.nan_inject_nodes {bad} out of range for "
                     f"topology.num_nodes={self.topology.num_nodes}"
                 )
+        return self
+
+    @model_validator(mode="after")
+    def _sparse_topology_is_wirable(self):
+        if self.topology.type not in ("exponential", "one_peer"):
+            return self
+        if self.backend == "distributed":
+            raise ValueError(
+                "sparse topologies (exponential/one_peer) run the [k, N] "
+                "edge-mask exchange engine, which lives in the jitted "
+                "backends; backend: distributed is not wired for it — use "
+                "backend: simulation or tpu"
+            )
+        if self.mobility is not None:
+            raise ValueError(
+                "sparse topologies do not compose with mobility (G^t is a "
+                "dense per-round graph); drop the mobility block or use a "
+                "dense topology"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "sparse topologies do not compose with dmtt (claim "
+                "verification needs the dense exchange graph)"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _population_is_wirable(self):
+        p = self.population
+        if p is None:
+            return self
+        if not p.enabled:
+            if p.virtual_size or p.cohort_size is not None:
+                # Same fail-loud discipline as the telemetry sub-settings:
+                # a sized population without the master switch would
+                # silently run as a plain N-node experiment.
+                raise ValueError(
+                    "population.virtual_size/cohort_size require "
+                    "population.enabled: true"
+                )
+            return self
+        n = self.topology.num_nodes
+        if p.cohort_size is not None and p.cohort_size != n:
+            raise ValueError(
+                f"population.cohort_size={p.cohort_size} must equal "
+                f"topology.num_nodes={n} — the cohort IS the compiled "
+                "round program's node axis"
+            )
+        if p.virtual_size < n:
+            raise ValueError(
+                f"population.virtual_size={p.virtual_size} must be >= "
+                f"topology.num_nodes={n} (the cohort is drawn without "
+                "replacement)"
+            )
+        if self.backend == "distributed":
+            raise ValueError(
+                "population (cohort streaming) swaps device-resident "
+                "state between rounds; backend: distributed keeps state "
+                "in per-node OS processes — use backend: simulation or tpu"
+            )
+        if self.sweep is not None:
+            raise ValueError(
+                "population does not compose with sweep (gang batching) "
+                "yet — run cohort-streaming experiments unganged"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "population does not compose with dmtt (trust state is "
+                "keyed by node identity, which cohort swaps reassign)"
+            )
         return self
 
     @model_validator(mode="after")

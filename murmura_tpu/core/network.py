@@ -40,6 +40,20 @@ def effective_adjacency(
     return adj
 
 
+def effective_edge_mask(topology, fault_schedule, round_idx: int) -> np.ndarray:
+    """One round's effective [k, N] sparse edge mask (topology/sparse.py):
+    the SparseTopology schedule (static all-ones / one_peer single-offset)
+    with the fault-schedule masks folded in host-side — the sparse twin of
+    :func:`effective_adjacency`, consumed by round programs built with
+    ``sparse_offsets``.  O(k·N) host work per round, never O(N^2)."""
+    mask = topology.edge_mask(round_idx)
+    if fault_schedule is not None:
+        mask = fault_schedule.masked_edge_mask(
+            mask, topology.offsets, round_idx
+        )
+    return mask
+
+
 def effective_alive(fault_schedule, num_nodes: int, round_idx: int) -> np.ndarray:
     """[N] float32 alive mask for a faulted program's extra input (shared
     single-run/gang helper, see :func:`effective_adjacency`)."""
@@ -216,6 +230,26 @@ class Network:
             raise ValueError(
                 f"Topology has {topology.num_nodes} nodes, data/model stack has {n}"
             )
+        if program.sparse:
+            from murmura_tpu.topology.sparse import SparseTopology
+
+            if not isinstance(topology, SparseTopology):
+                raise ValueError(
+                    "the round program was built with sparse_offsets but "
+                    "the topology is not a SparseTopology — the program's "
+                    "adjacency input is a [k, N] edge mask only a sparse "
+                    "topology can produce"
+                )
+            if tuple(topology.offsets) != tuple(program.sparse_offsets):
+                raise ValueError(
+                    f"sparse topology offsets {tuple(topology.offsets)} != "
+                    f"round program offsets {tuple(program.sparse_offsets)}"
+                )
+            if mobility is not None:
+                raise ValueError(
+                    "sparse exchange mode does not compose with mobility "
+                    "(G^t is a dense per-round graph)"
+                )
 
         self.compromised = (
             attack.compromised.astype(np.float32)
@@ -239,13 +273,26 @@ class Network:
             self._step = shard_step(program.train_step, program, mesh, donate=donate)
             self._eval = shard_eval_step(program.eval_step, program, mesh)
             self._node_s, self._repl = make_shardings(mesh)
-            self._adj_stack_s = adj_stack_sharding(mesh)
+            if program.sparse:
+                # Sparse adjacency inputs carry the node axis SECOND
+                # ([k, N] per-round mask, [chunk, k, N] fused stack).
+                from murmura_tpu.parallel.mesh import (
+                    edge_mask_sharding,
+                    sparse_adj_stack_sharding,
+                )
+
+                self._adj_s = edge_mask_sharding(mesh)
+                self._adj_stack_s = sparse_adj_stack_sharding(mesh)
+            else:
+                self._adj_s = self._node_s
+                self._adj_stack_s = adj_stack_sharding(mesh)
         else:
             self.mesh = None
             donate_argnums = (0, 1) if donate else ()
             self._step = jax.jit(program.train_step, donate_argnums=donate_argnums)
             self._eval = jax.jit(program.eval_step)
-            self._node_s = self._repl = self._adj_stack_s = None
+            self._node_s = self._repl = None
+            self._adj_s = self._adj_stack_s = None
         if transfer_guard and jax.process_count() > 1:
             raise ValueError(
                 "tpu.transfer_guard is single-host only: multi-host "
@@ -322,6 +369,15 @@ class Network:
         return jax.device_put(value, sharding)
 
     def _adjacency_for_round(self, round_idx: int) -> np.ndarray:
+        if self.program.sparse:
+            mask = effective_edge_mask(
+                self.topology, self.fault_schedule, round_idx
+            )
+            if self.telemetry is not None:
+                self._in_degree_cache[round_idx] = (
+                    self.topology.in_degree_from_edge_mask(mask)
+                )
+            return mask
         adj = effective_adjacency(
             self.topology, self.mobility, self.fault_schedule, round_idx
         )
@@ -606,7 +662,7 @@ class Network:
             warmup = "step" not in self._warmed
             if self._tracker is not None:
                 self._tracker.begin(f"round {round_idx}")
-            adj = self._stage(self._adjacency_for_round(round_idx), self._node_s)
+            adj = self._stage(self._adjacency_for_round(round_idx), self._adj_s)
             # 0-d numpy staging: scalar conversions from numpy ARRAYS are
             # explicit transfers (transfer_guard-clean); Python/numpy
             # scalars would be implicit and trip the sanitizer.
@@ -761,9 +817,11 @@ class Network:
                 if r >= round_num
             }
             if in_deg is None:
-                in_deg = np.asarray(
-                    self._adjacency_for_round(round_num - 1)
-                ).sum(axis=0)
+                # Re-running the round's adjacency build repopulates the
+                # cache with the mode-correct in-degree (dense column sums
+                # or the sparse edge-mask roll sums).
+                self._adjacency_for_round(round_num - 1)
+                in_deg = self._in_degree_cache.pop(round_num - 1)
             self.telemetry.round_event(
                 round_num,
                 {k: np.asarray(v) for k, v in metrics.items()},

@@ -90,6 +90,16 @@ class RoundProgram:
     # a gang (core/gang.py) can vary them per member under vmap.  () =>
     # the traced program is byte-identical to pre-gang builds.
     hp_inputs: Tuple[str, ...] = ()
+    # Sparse exchange mode (topology/sparse.py; docs/SCALING.md): when
+    # non-empty, the program's adjacency input is the [k, N] per-offset
+    # edge mask of a SparseTopology instead of the dense [N, N] matrix —
+    # nothing O(N^2) enters the lowered HLO (MUR600).  () => byte-identical
+    # to pre-sparse builds.
+    sparse_offsets: Tuple[int, ...] = ()
+
+    @property
+    def sparse(self) -> bool:
+        return bool(self.sparse_offsets)
 
 
 def _broadcast_to_leaf(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
@@ -117,6 +127,7 @@ def build_round_program(
     faults: Optional[FaultSpec] = None,
     audit_taps: bool = False,
     hp_inputs: Tuple[str, ...] = (),
+    sparse_offsets: Optional[Tuple[int, ...]] = None,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -159,6 +170,41 @@ def build_round_program(
     n = data.num_nodes
     num_classes = data.num_classes or model.num_classes
     evidential = model.evidential
+
+    # Sparse exchange mode: the adjacency input is the [k, N] per-offset
+    # edge mask of a SparseTopology (edge i <- (i + o) % N active), never a
+    # dense [N, N] matrix.  Every adjacency manipulation below then runs in
+    # edge-mask space via rolls of [N] node flags (which lower to boundary
+    # ppermutes on a sharded node axis, like the circulant rules' rolls).
+    sparse_offsets = (
+        tuple(int(o) for o in sparse_offsets) if sparse_offsets else ()
+    )
+    sparse = bool(sparse_offsets)
+    if sparse and dmtt is not None:
+        raise ValueError(
+            "sparse exchange mode does not compose with DMTT (claim "
+            "verification needs the dense per-round exchange graph)"
+        )
+
+    def _sender_view(vec):  # murmura: traced
+        """[k, N] sender-side view of a [N] node flag: row j holds
+        vec[(i + offsets[j]) % N] at column i."""
+        return jnp.stack([jnp.roll(vec, -o) for o in sparse_offsets])
+
+    def _edges_mask_both(adj, vec):  # murmura: traced
+        """Drop edges whose receiver OR sender has flag 0."""
+        if sparse:
+            return adj * vec[None, :] * _sender_view(vec)
+        return adj * vec[:, None] * vec[None, :]
+
+    def _edges_mask_sender(adj, vec):  # murmura: traced
+        """Drop edges whose sender has flag 0."""
+        if sparse:
+            return adj * _sender_view(vec)
+        return adj * vec[None, :]
+
+    def _in_degree(adj):  # murmura: traced
+        return adj.sum(axis=0) if sparse else adj.sum(axis=1)
 
     hp_inputs = tuple(hp_inputs)
     unknown_hp = set(hp_inputs) - {"lr", "attack_scale"}
@@ -376,8 +422,9 @@ def build_round_program(
             # masked_adjacency already folds it host-side (idempotent:
             # alive*alive == alive) — the program must not depend on a
             # two-sources-of-truth contract between its adj and alive
-            # inputs to keep dead nodes out of the exchange.
-            adj = adj * alive[:, None] * alive[None, :]
+            # inputs to keep dead nodes out of the exchange.  (Sparse
+            # exchange mode runs the same fold in [k, N] edge-mask space.)
+            adj = _edges_mask_both(adj, alive)
             train_mask = train_mask * alive
             pre_flat = jax.vmap(ravel)(params)
         # named_scope brackets label the `# murmura: traced` phases in
@@ -419,7 +466,7 @@ def build_round_program(
                 ) * alive_f
             own_flat = jnp.where(finite[:, None], own_flat, pre_flat)
             fin = finite.astype(adj.dtype)
-            adj = adj * fin[:, None] * fin[None, :]
+            adj = _edges_mask_both(adj, fin)
         else:
             finite = None
         if attack_apply is not None:
@@ -454,7 +501,7 @@ def build_round_program(
                 # containment is visible in history, not silent.
                 bfin = jnp.isfinite(bcast).all(axis=1)
                 bcast = jnp.where(bfin[:, None], bcast, own_flat)
-                adj = adj * bfin[None, :].astype(adj.dtype)
+                adj = _edges_mask_sender(adj, bfin.astype(adj.dtype))
                 fault_stats["attack_scrubbed"] = (
                     1.0 - bfin.astype(jnp.float32)
                 ).sum()
@@ -515,7 +562,7 @@ def build_round_program(
             # Zero alive neighbors (everyone crashed/dropped/straggled)
             # degrades to self-model — some rules divide by degree and
             # jnp.where cleanly discards whatever they produced there.
-            deg = adj.sum(axis=1)
+            deg = _in_degree(adj)
             new_flat = jnp.where((deg > 0)[:, None], new_flat, own_flat)
             # Dead nodes' params freeze at the pre-round value (their
             # process is gone; nothing may advance) and quarantined nodes
@@ -570,6 +617,7 @@ def build_round_program(
         evidential=evidential,
         faulted=faults is not None,
         hp_inputs=hp_inputs,
+        sparse_offsets=sparse_offsets,
     )
 
 

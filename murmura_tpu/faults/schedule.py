@@ -206,6 +206,32 @@ class FaultSchedule:
         np.fill_diagonal(out, 0.0)
         return out
 
+    def masked_edge_mask(
+        self, edge_mask: np.ndarray, offsets, round_idx: int
+    ) -> np.ndarray:
+        """Fold this round's faults into a sparse [k, N] edge mask.
+
+        The sparse-exchange twin of :meth:`masked_adjacency`
+        (topology/sparse.py): entry ``[j, i]`` is the edge
+        ``i <- (i + offsets[j]) % N``, so the same multiplicative fold —
+        receiver alive, sender alive, link up, sender not straggling —
+        runs per offset row instead of over an [N, N] matrix.  Same
+        contract (MUR301): masks may only *remove* edges.
+        """
+        self._ensure(round_idx)
+        alive = self._alive[round_idx]
+        link = self._link_up[round_idx]
+        not_straggling = 1.0 - self._straggle[round_idx].astype(np.float32)
+        out = np.asarray(edge_mask, dtype=np.float32).copy()
+        idx = np.arange(self.num_nodes)
+        for j, o in enumerate(offsets):
+            sender = (idx + int(o)) % self.num_nodes
+            out[j] *= (
+                alive * alive[sender] * link[idx, sender]
+                * not_straggling[sender]
+            )
+        return out
+
     # ------------------------------------------------------------------
     # Transition views (FaultInjector / node self-enforcement)
 

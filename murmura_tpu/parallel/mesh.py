@@ -168,8 +168,9 @@ def shard_step(step, program, mesh: Mesh, donate: bool = True):
         The compiled step with in/out shardings pinned.
     """
     node_s, _ = make_shardings(mesh)
+    adj_s = edge_mask_sharding(mesh) if program.sparse else node_s
     return _shard_round_fn(
-        step, program, mesh, node_s, donate, alive_sharding=node_s
+        step, program, mesh, adj_s, donate, alive_sharding=node_s
     )
 
 
@@ -181,13 +182,30 @@ def adj_stack_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(None, "nodes"))
 
 
+def edge_mask_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of the sparse [k, N] per-offset edge mask
+    (topology/sparse.py): the node axis is SECOND, the small static offset
+    axis replicates — each device holds its nodes' columns of every offset
+    row."""
+    return NamedSharding(mesh, P(None, "nodes"))
+
+
+def sparse_adj_stack_sharding(mesh: Mesh) -> NamedSharding:
+    """Fused-dispatch sparse edge-mask stack [chunk, k, N]: node axis third."""
+    return NamedSharding(mesh, P(None, None, "nodes"))
+
+
 def shard_multi_round(multi_round, program, mesh: Mesh, donate: bool = True):
     """Jit a fused multi-round scan (core.rounds.build_multi_round) over
     ``mesh`` with the same node-axis layout as :func:`shard_step`.  The
     faulted alive_stack [chunk, N] shares the adj_stack's layout: sharded
     on its second (node) axis."""
+    adj_s = (
+        sparse_adj_stack_sharding(mesh) if program.sparse
+        else adj_stack_sharding(mesh)
+    )
     return _shard_round_fn(
-        multi_round, program, mesh, adj_stack_sharding(mesh), donate,
+        multi_round, program, mesh, adj_s, donate,
         alive_sharding=adj_stack_sharding(mesh),
     )
 

@@ -1,0 +1,21 @@
+"""Population engine: sampled-cohort streaming for virtual populations far
+larger than the compiled node axis (ISSUE 6; docs/SCALING.md).
+
+- :mod:`sampler` — seed-deterministic cohort draws (``SAMPLERS`` registry,
+  MUR602-pinned against the config schema enum);
+- :mod:`bank` — memory-mapped, lazily-initialized per-user model rows;
+- :mod:`engine` — the cohort-streaming orchestrator
+  (:class:`PopulationNetwork`) with double-buffered swap staging.
+"""
+
+from murmura_tpu.population.bank import PopulationBank
+from murmura_tpu.population.engine import PopulationNetwork, PopulationSpec
+from murmura_tpu.population.sampler import SAMPLERS, draw_cohort
+
+__all__ = [
+    "PopulationBank",
+    "PopulationNetwork",
+    "PopulationSpec",
+    "SAMPLERS",
+    "draw_cohort",
+]

@@ -1,7 +1,20 @@
 """Graph topologies for decentralized FL (reference: murmura/topology/)."""
 
 from murmura_tpu.topology.base import Topology
-from murmura_tpu.topology.generators import create_topology, TOPOLOGY_TYPES
+from murmura_tpu.topology.generators import (
+    SPARSE_TOPOLOGY_TYPES,
+    TOPOLOGY_TYPES,
+    create_topology,
+)
 from murmura_tpu.topology.dynamic import MobilityModel
+from murmura_tpu.topology.sparse import SparseTopology, exponential_offsets
 
-__all__ = ["Topology", "create_topology", "MobilityModel", "TOPOLOGY_TYPES"]
+__all__ = [
+    "Topology",
+    "SparseTopology",
+    "create_topology",
+    "exponential_offsets",
+    "MobilityModel",
+    "TOPOLOGY_TYPES",
+    "SPARSE_TOPOLOGY_TYPES",
+]

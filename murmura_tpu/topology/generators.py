@@ -11,8 +11,13 @@ from typing import Optional
 import numpy as np
 
 from murmura_tpu.topology.base import Topology
+from murmura_tpu.topology.sparse import SparseTopology, exponential_offsets
 
-TOPOLOGY_TYPES = ("ring", "fully", "erdos", "k-regular")
+# Sparse (offset-list) families: create_topology returns a SparseTopology
+# for these — the round program then takes a [k, N] edge mask instead of
+# the dense [N, N] adjacency (topology/sparse.py; docs/SCALING.md).
+SPARSE_TOPOLOGY_TYPES = ("exponential", "one_peer")
+TOPOLOGY_TYPES = ("ring", "fully", "erdos", "k-regular") + SPARSE_TOPOLOGY_TYPES
 
 
 def create_topology(
@@ -22,7 +27,7 @@ def create_topology(
     k: Optional[int] = None,
     seed: int = 12345,
     **_ignored,
-) -> Topology:
+) -> "Topology | SparseTopology":
     """Create a topology by name (reference: generators.py:11-46)."""
     t = topology_type.lower()
     if t == "ring":
@@ -33,6 +38,10 @@ def create_topology(
         return erdos_renyi(num_nodes, 0.3 if p is None else p, seed)
     if t in ("k-regular", "kregular"):
         return k_regular(num_nodes, 4 if k is None else k)
+    if t == "exponential":
+        return exponential(num_nodes)
+    if t in ("one_peer", "one-peer"):
+        return one_peer(num_nodes)
     raise ValueError(f"Unknown topology type: {topology_type}")
 
 
@@ -75,6 +84,25 @@ def erdos_renyi(n: int, p: float, seed: int = 12345) -> Topology:
             if i != j:
                 adj[i, j] = adj[j, i] = True
     return Topology(num_nodes=n, adjacency=adj)
+
+
+def exponential(n: int) -> SparseTopology:
+    """Static exponential graph (arXiv:2110.13363): directed circulant with
+    offsets ``2^i mod n`` — degree O(log n) at any n, never ``[N, N]``.
+    Offsets are deduped and a degenerate 0 offset is rejected loudly
+    (:func:`murmura_tpu.topology.sparse.exponential_offsets`)."""
+    return SparseTopology(num_nodes=n, offsets=exponential_offsets(n))
+
+
+def one_peer(n: int) -> SparseTopology:
+    """One-peer exponential graph (arXiv:2110.13363 §one-peer): the same
+    offset set as :func:`exponential`, but only offset ``t mod k`` active
+    in round ``t`` — per-round degree 1, cycling through the exponential
+    offsets.  The activation arrives as edge-mask values, so one compiled
+    program covers every round."""
+    return SparseTopology(
+        num_nodes=n, offsets=exponential_offsets(n), schedule="one_peer"
+    )
 
 
 def k_regular(n: int, k: int) -> Topology:

@@ -427,6 +427,21 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None):
         k=config.topology.k,
         seed=config.topology.seed,
     )
+    from murmura_tpu.topology.sparse import SparseTopology
+
+    if isinstance(topology, SparseTopology):
+        raise ConfigError(
+            "sparse topologies (exponential/one_peer) are not gang-"
+            "batchable yet: the gang mesh shards the [N, N] adjacency on "
+            "its node rows, and the sparse [k, N] edge mask needs a "
+            "different layout — run sparse experiments unganged"
+        )
+    if config.population is not None and config.population.enabled:
+        raise ConfigError(
+            "population (cohort streaming) does not compose with "
+            "gang-batched execution — run cohort-streaming experiments "
+            "unganged"
+        )
     # ONE attack for the whole gang: its compromised placement is seeded by
     # attack.params.seed (default: the base experiment seed), never by the
     # member seed — member programs share the attack's static closures
@@ -633,7 +648,19 @@ def build_network_from_config(
     # (ubar.py:169).
     agg_params = dict(config.aggregation.params)
 
-    if config.backend == "tpu" and config.tpu.exchange == "ppermute":
+    from murmura_tpu.topology.sparse import SparseTopology
+
+    sparse = isinstance(topology, SparseTopology)
+    if sparse:
+        # Sparse topologies (exponential/one_peer) ALWAYS run the [k, N]
+        # edge-mask engine: the circulant rule paths with mask weights and
+        # a round program whose adjacency input is the per-offset mask —
+        # nothing O(N^2) is built on any backend (tpu.exchange is moot;
+        # both settings route here).  Mobility/dmtt combinations were
+        # rejected at schema validation.
+        agg_params["exchange_offsets"] = list(topology.offsets)
+        agg_params["sparse_exchange"] = True
+    elif config.backend == "tpu" and config.tpu.exchange == "ppermute":
         # O(degree) neighbor exchange via circular shifts (circulant paths
         # in all six rules; krum assembles its candidate-pair distances
         # from rolled delta vectors instead of the global Gram matrix).
@@ -651,6 +678,7 @@ def build_network_from_config(
         agg_params["exchange_offsets"] = offsets
     if (
         config.aggregation.algorithm in ("krum", "median", "trimmed_mean", "geometric_median")
+        and not sparse
         and mobility is None
         and config.dmtt is None
     ):
@@ -705,6 +733,7 @@ def build_network_from_config(
         node_axis_sharded=_node_axis_sharded(config, mesh),
         faults=build_fault_spec(config),
         audit_taps=config.telemetry.audit_taps,
+        sparse_offsets=tuple(topology.offsets) if sparse else None,
     )
 
     if config.backend == "tpu" and mesh is None:
@@ -712,7 +741,7 @@ def build_network_from_config(
 
         mesh = make_mesh(config.tpu.num_devices)
 
-    return Network(
+    net_kwargs = dict(
         program=program,
         topology=topology,
         attack=attack,
@@ -726,4 +755,30 @@ def build_network_from_config(
         transfer_guard=config.tpu.transfer_guard,
         fault_schedule=build_fault_schedule(config),
         telemetry=build_telemetry_writer(config, resume=telemetry_resume),
+    )
+    spec = build_population_spec(config)
+    if spec is not None:
+        from murmura_tpu.population import PopulationNetwork
+
+        return PopulationNetwork(**net_kwargs, population=spec)
+    return Network(**net_kwargs)
+
+
+def build_population_spec(config: Config):
+    """PopulationSpec from config.population, or None when off — the
+    single construction path for every consumer, so cohort-draw semantics
+    cannot drift between the orchestrator and any future tooling."""
+    p = config.population
+    if p is None or not p.enabled:
+        return None
+    from murmura_tpu.population import PopulationSpec
+
+    return PopulationSpec(
+        virtual_size=p.virtual_size,
+        sampler=p.sampler,
+        seed=p.seed,
+        rounds_per_cohort=p.rounds_per_cohort,
+        data_binding=p.data_binding,
+        bank_dir=p.bank_dir,
+        inherit=p.inherit,
     )

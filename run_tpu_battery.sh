@@ -12,11 +12,13 @@ set -u
 CHAOS=0
 PROFILE=0
 GANG=0
+POPULATION=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
     --profile) PROFILE=1; shift;;
     --gang) GANG=1; shift;;
+    --population) POPULATION=1; shift;;
     *) break;;
   esac
 done
@@ -173,10 +175,108 @@ PYEOF
   fi
   echo "preflight gang clean" | tee -a "$OUT/battery.log"
 fi
+# Optional population pre-flight (./run_tpu_battery.sh --population
+# [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph
+# round program must run AND lower with no O(N^2) value (the MUR600
+# contract at full acceptance scale), and (b) a virtual_size=100k
+# cohort-streaming run must swap cohorts 3 times with ZERO post-warmup
+# recompiles (CompileTracker via tpu.recompile_guard) and seed-
+# deterministic draws.  CPU-pinned like the other gates.
+if [ "$POPULATION" = 1 ]; then
+  echo "=== preflight: population (4096-node sparse + 100k cohort swap) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 1200 env JAX_PLATFORMS=cpu python - > "$OUT/preflight_population.out" 2>&1 <<'PYEOF'
+import sys
+import numpy as np
+import jax
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+
+def raw(**over):
+    r = {
+        "experiment": {"name": "pop-preflight", "seed": 11, "rounds": 3},
+        "topology": {"type": "exponential", "num_nodes": 4096},
+        "aggregation": {"algorithm": "fedavg", "params": {}},
+        "training": {"local_epochs": 1, "batch_size": 2, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 4096 * 2, "input_dim": 10,
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 10, "hidden_dims": [8],
+                             "num_classes": 3}},
+        "backend": "simulation",
+    }
+    r.update(over)
+    return r
+
+# -- (a) 4096-node exponential smoke + no-[N,N] lowering proof ----------
+net = build_network_from_config(Config.model_validate(raw()))
+n = net.program.num_nodes
+adj = net._adjacency_for_round(0)
+assert adj.shape == (len(net.topology.offsets), n), adj.shape
+import jax.numpy as jnp
+args = [net.params, net.agg_state, jax.random.PRNGKey(0),
+        jnp.asarray(adj), jnp.asarray(net.compromised),
+        jnp.asarray(0.0, jnp.float32), net._data]
+jaxpr = jax.make_jaxpr(net.program.train_step)(*args)
+def eqns(jx):
+    jx = getattr(jx, "jaxpr", jx)
+    for e in jx.eqns:
+        yield e
+        for sub in e.params.values():
+            for s in (sub if isinstance(sub, (list, tuple)) else [sub]):
+                if hasattr(s, "jaxpr") or hasattr(s, "eqns"):
+                    yield from eqns(s)
+dense = set()
+for e in eqns(jaxpr):
+    for v in list(e.invars) + list(e.outvars):
+        shape = tuple(getattr(getattr(v, "aval", None), "shape", ()) or ())
+        if sum(1 for d in shape if d == n) >= 2:
+            dense.add((e.primitive.name, shape))
+if dense:
+    print(f"4096-node sparse program traces O(N^2) values: {sorted(dense)[:5]}")
+    sys.exit(1)
+hist = net.train(rounds=2, eval_every=2)
+if not np.isfinite(hist["mean_loss"]).all():
+    print("4096-node sparse run produced non-finite loss")
+    sys.exit(1)
+print(f"4096-node exponential smoke ok: degree={len(net.topology.offsets)}, "
+      f"acc={hist['mean_accuracy'][-1]:.3f}, no O(N^2) values in the jaxpr")
+
+# -- (b) 100k-user cohort streaming: zero recompiles across 3 swaps -----
+r = raw(topology={"type": "exponential", "num_nodes": 16},
+        population={"enabled": True, "virtual_size": 100_000,
+                    "sampler": "uniform", "seed": 5},
+        tpu={"recompile_guard": True})
+r["data"]["params"]["num_samples"] = 16 * 8
+r["training"]["batch_size"] = 8
+net = build_network_from_config(Config.model_validate(r))
+# tpu.recompile_guard raises RecompileError on ANY post-warmup compile —
+# 3 cohort swaps under the guard ARE the zero-recompile assertion.
+net.train(rounds=3, eval_every=1)
+if net.cohorts_seen != 3:
+    print(f"expected 3 cohort swaps, saw {net.cohorts_seen}")
+    sys.exit(1)
+from murmura_tpu.population import draw_cohort
+a = draw_cohort("uniform", 100_000, 16, 2, 5)
+b = draw_cohort("uniform", 100_000, 16, 2, 5)
+if not np.array_equal(a, b):
+    print("cohort draws are not seed-deterministic")
+    sys.exit(1)
+print(f"100k cohort streaming ok: 3 swaps, zero post-warmup recompiles, "
+      f"{net.bank.activated} users activated, draws deterministic")
+PYEOF
+  then
+    echo "preflight population FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_population.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight population clean" | tee -a "$OUT/battery.log"
+fi
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py
 run breakdown256   2400 python bench_breakdown.py --nodes 256
 run sgd_micro      1800 python bench_sgd_micro.py
 run rules256       3600 python bench_rules_256.py
 run scaling        14400 python bench_scaling.py
+run scaling_sparse 7200 python bench_scaling.py --sparse
 echo "battery done $(date)" | tee -a "$OUT/battery.log"

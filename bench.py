@@ -178,7 +178,7 @@ def _peak_flops(device_kind: str):
 
 def bench_config(on_cpu: bool, num_nodes: int = 20,
                  param_dtype: str = "float32", exchange: str = "allgather",
-                 sweep: dict = None):
+                 sweep: dict = None, compression: dict = None):
     from murmura_tpu.config import Config
 
     raw = {
@@ -224,6 +224,8 @@ def bench_config(on_cpu: bool, num_nodes: int = 20,
         }
     if sweep is not None:
         raw["sweep"] = sweep
+    if compression is not None:
+        raw["compression"] = compression
     return Config.model_validate(raw)
 
 
@@ -343,6 +345,53 @@ def main():
             "timed_block_compiles": timed_compiles,
         }
 
+    def measure_compression(num_nodes: int, compression: dict,
+                            rounds: int) -> dict:
+        """Compressed-exchange variant (ops/compress.py; ISSUE 7): the
+        headline krum scenario on the circulant (ppermute) exchange with
+        the given ``compression:`` block, at ``num_nodes``.  Reports
+        rounds/sec, the measured AOT cost line, and the ANALYTIC exchange
+        bytes (edges x what actually crosses an edge:
+        Network.exchange_cost_analysis) so the bytes reduction is
+        committed history next to the measured numbers."""
+        from murmura_tpu.utils.factories import build_network_from_config
+
+        cfg = bench_config(
+            on_cpu, num_nodes=num_nodes,
+            param_dtype="float32" if on_cpu else (
+                "bfloat16" if num_nodes >= 64 else "float32"
+            ),
+            exchange="ppermute", compression=compression,
+        )
+        network = build_network_from_config(cfg)
+
+        def block():
+            t0 = time.perf_counter()
+            network.train(rounds=rounds, eval_every=rounds,
+                          rounds_per_dispatch=rounds)
+            return time.perf_counter() - t0
+
+        compile_s = block()
+        block()  # steady-state layout recompile absorber
+        elapsed = block()
+        rec = {
+            "rounds_per_sec": round(rounds / elapsed, 3),
+            "compile_s": round(compile_s, 2),
+            "exchange": network.exchange_cost_analysis(),
+        }
+        try:
+            cost = network.step_cost_analysis()
+            rec["flops"] = float(cost.get("flops", 0.0)) or None
+            rec["bytes_accessed"] = float(
+                cost.get("bytes accessed", 0.0)
+            ) or None
+        except Exception:
+            pass
+        ce = network.history.get("agg_compress_error")
+        if ce:
+            rec["compress_error_final"] = round(float(ce[-1]), 6)
+        return rec
+
     # Headline config (float32 resident params) plus — on the chip — the
     # bf16-resident-params lever (tpu.param_dtype, the documented large-N
     # setting: halves the [N, P] state and the SGD update's HBM traffic).
@@ -410,6 +459,33 @@ def main():
                 rec["aggregate_rounds_per_sec"], 3
             )
 
+    # Compressed-exchange variants (none / int8+EF / topk+EF) at N=32 and
+    # — on the chip — the 256-node north-star scale.  The analytic
+    # exchange-bytes column is the acceptance surface (int8 >= 3x vs the
+    # uncompressed f32 rows; topk ~25x); failures stay attributable
+    # without losing the headline.
+    compress_results, compress_error = {}, None
+    compress_codecs = {
+        "none": {},
+        "int8": {"algorithm": "int8", "error_feedback": True},
+        "topk": {"algorithm": "topk", "topk_ratio": 0.05,
+                 "error_feedback": True},
+    }
+    compress_sizes = (32,) if on_cpu else (32, 256)
+    compress_rounds = 3 if on_cpu else timed_rounds
+    for n_ in compress_sizes:
+        compress_results[str(n_)] = {}
+        for label, codec in compress_codecs.items():
+            try:
+                compress_results[str(n_)][label] = measure_compression(
+                    n_, codec, compress_rounds
+                )
+            except Exception as e:  # noqa: BLE001 — attributable, not fatal
+                compress_error = (
+                    f"N={n_} {label}: {type(e).__name__}: {e}"[:300]
+                )
+                break
+
     def emit(north_star, north_star_error):
         payload = {
                     "metric": "fl_rounds_per_sec_krum_femnist_cnn_20node",
@@ -453,6 +529,12 @@ def main():
                     # block (timed block must be 0).
                     "gang": gang_results or None,
                     "gang_error": gang_error,
+                    # Compressed-exchange variants (ops/compress.py):
+                    # rounds/sec + measured cost + ANALYTIC exchange bytes
+                    # per codec at each scale, so the bytes reduction is
+                    # visible in every BENCH_*.json.
+                    "compression": compress_results or None,
+                    "compression_error": compress_error,
         }
         # The stdout JSON line is the driver contract (last line wins) and
         # stays; the SAME payload also lands as a kind:bench telemetry

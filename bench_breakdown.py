@@ -131,7 +131,7 @@ PROBE_CFG = {
 }
 
 
-def build(algo: str, local_epochs: int, raw_cfg=None):
+def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
     from murmura_tpu.aggregation import build_aggregator
     from murmura_tpu.aggregation.base import AggregatorDef
     from murmura_tpu.config import Config
@@ -177,6 +177,7 @@ def build(algo: str, local_epochs: int, raw_cfg=None):
         model, agg, data,
         local_epochs=local_epochs, batch_size=32, lr=0.05, total_rounds=10,
         attack=attack, seed=7, probe_size=probe_size,
+        compression=compression,
     )
     return program, attack
 
@@ -227,6 +228,41 @@ def main():
                 "ms": round(1e3 * _timed_eval(ev, program.init_params, d), 3)
             }
 
+    # Compressed-exchange deltas (ops/compress.py; ISSUE 7): the same
+    # full krum round with the int8 / topk codec armed — (compressed
+    # krum step) - (krum_e1) is the in-round cost (or saving: the codec
+    # shrinks the aggregation's HBM reads) of quantize + dequantize +
+    # error feedback, next to the analytic exchange-bytes column.
+    from murmura_tpu.ops.compress import CompressionSpec
+
+    model_dim = None
+    for cname, spec in (
+        ("krum_e1_int8", CompressionSpec(
+            "int8", block=256, error_feedback=True)),
+        ("krum_e1_topk", CompressionSpec(
+            "topk", topk_ratio=0.05, error_feedback=True)),
+    ):
+        program, attack = build(
+            "krum", 1, raw_cfg=flagship_cfg(nodes), compression=spec
+        )
+        model_dim = program.model_dim
+        step = jax.jit(program.train_step)
+        d = {k: jnp.asarray(v) for k, v in program.data_arrays.items()}
+        args = (
+            program.init_params,
+            {k: jnp.asarray(v) for k, v in program.init_agg_state.items()},
+            jax.random.PRNGKey(0), adj, comp,
+            jnp.asarray(0.0, jnp.float32), d,
+        )
+        t0 = time.perf_counter()
+        results[cname] = {
+            "ms": round(1e3 * _timed_step(step, args), 3),
+            "payload_bytes_per_edge": spec.payload_bytes(program.model_dim, 4),
+        }
+        results[cname]["compile_and_time_s"] = round(
+            time.perf_counter() - t0, 1
+        )
+
     seg = {
         "overhead_ms": results["overhead"]["ms"],
         "attack_ms": round(
@@ -240,6 +276,17 @@ def main():
         ),
         "eval_ms": results["eval"]["ms"],
         "full_round_ms": results["krum_e1"]["ms"],
+        "compress_int8_delta_ms": round(
+            results["krum_e1_int8"]["ms"] - results["krum_e1"]["ms"], 3
+        ),
+        "compress_topk_delta_ms": round(
+            results["krum_e1_topk"]["ms"] - results["krum_e1"]["ms"], 3
+        ),
+        "exchange_payload_bytes": {
+            "none": model_dim * 4,
+            "int8": results["krum_e1_int8"]["payload_bytes_per_edge"],
+            "topk": results["krum_e1_topk"]["payload_bytes_per_edge"],
+        },
     }
 
     if nodes != 20:

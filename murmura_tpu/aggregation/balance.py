@@ -62,11 +62,13 @@ def make_balance(
     min_neighbors: int = 1,
     exchange_offsets: Optional[Sequence[int]] = None,
     sparse_exchange: bool = False,
+    pallas: bool = False,
     **_params,
 ) -> AggregatorDef:
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
     if sparse_exchange and offsets is None:
         raise ValueError("sparse_exchange requires exchange_offsets")
+    pallas = bool(pallas)  # ops/pallas_agg.py fused distance kernels
 
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         lambda_t = round_idx / jnp.maximum(1, ctx.total_rounds)
@@ -77,7 +79,9 @@ def make_balance(
             # O(degree) circulant path (tpu.exchange: ppermute): distances,
             # thresholding, closest-fallback, and the accepted mean all over
             # k rolled copies instead of [N, N] tensors.
-            d_k = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
+            d_k = circulant_neighbor_distances(
+                own, bcast, offsets, pallas=pallas
+            )  # [k, N]
             if sparse_exchange:
                 # Sparse exchange mode: ``adj`` is the [k, N] edge mask —
                 # inactive edges are excluded from acceptance, the closest-
@@ -127,7 +131,7 @@ def make_balance(
                         (own.shape[0],), float(len(offsets))
                     )
         else:
-            dist = pairwise_l2_distances(own, bcast)
+            dist = pairwise_l2_distances(own, bcast, pallas=pallas)
             accepted = accept_with_closest_fallback(
                 dist, adj, threshold, min_neighbors
             )
@@ -160,4 +164,8 @@ def make_balance(
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"ppermute"},
         },
+        # Compressed exchange: the circulant path touches the broadcast
+        # only through the shared roll kernels, which move the int8
+        # payload (MUR700).
+        quantized_exchange=offsets is not None,
     )

@@ -35,6 +35,8 @@ from typing import (
 import jax
 import jax.numpy as jnp
 
+from murmura_tpu.ops.compress import Int8Blocks
+
 Stats = Dict[str, jnp.ndarray]
 AggState = Dict[str, jnp.ndarray]
 
@@ -124,6 +126,15 @@ class AggregatorDef:
     needs_probe: bool = False
     state_kind: Dict[str, str] = field(default_factory=dict)
     collectives: Optional[Mapping[str, Collection[str]]] = None
+    # True when this rule's exchange consumes the broadcast exclusively
+    # through the shared circulant kernels below, which accept the int8
+    # compressed payload (ops/compress.Int8Blocks) in place of the float
+    # tensor — the rolls then move int8 + per-block scales through the
+    # boundary ppermutes instead of a dequantized [*, P] float operand
+    # (compressed exchange, MUR700).  Rules that run arbitrary math over
+    # the broadcast (probe forwards, sketch tables) keep False and receive
+    # the receiver-side dequantized tensor from core/rounds.py.
+    quantized_exchange: bool = False
 
     def declared_collectives(self, circulant) -> Optional[FrozenSet[str]]:
         """Allowed collective set for one exchange mode (``None`` =
@@ -150,7 +161,7 @@ class AggregatorDef:
 
 
 def pairwise_l2_distances(
-    a: jnp.ndarray, b: Optional[jnp.ndarray] = None
+    a: jnp.ndarray, b: Optional[jnp.ndarray] = None, pallas: bool = False
 ) -> jnp.ndarray:
     """L2 distance matrix D[i, j] = ||a_i - b_j|| via one Gram matmul.
 
@@ -173,6 +184,15 @@ def pairwise_l2_distances(
     center = jnp.mean(a32, axis=0, keepdims=True)
     a32 = a32 - center
     b32 = a32 if same else b32 - center
+    if pallas:
+        # Fused streamed kernel (ops/pallas_agg.py): Gram matmul + norms +
+        # combination in one pass over the centered operands.  None =
+        # shapes outside the kernel envelope; fall through to the lax path.
+        from murmura_tpu.ops import pallas_agg
+
+        d2p = pallas_agg.pairwise_sq_distances(a32, b32)
+        if d2p is not None:
+            return jnp.sqrt(jnp.maximum(d2p, 0.0))
     # Squared norms and the final combination accumulate in f32 regardless
     # of input dtype: with bf16 params (tpu.param_dtype) a bf16 reduction
     # would quantize the small post-centering distances the selection ranks
@@ -206,17 +226,25 @@ def pairwise_l2_distances(
 _CIRCULANT_CHUNK_BYTES = 256 * 1024 * 1024
 
 
-def _p_chunk_len(n: int, p: int, itemsize: int) -> int:
+def _p_chunk_len(n: int, p: int, itemsize: int, floor: int = 4) -> int:
     """Chunk length along P so one [N, chunk] rolled copy stays in budget.
 
-    The budget floor is the f32 itemsize even for bf16 inputs: every
-    circulant kernel accumulates its chunk in float32 (distance reduces,
-    weighted sums), so a bf16 program's live per-chunk working set is the
-    f32 upcast, not the resident dtype — sizing by itemsize=2 would double
-    the chunk and hand back the OOM headroom the 256-node north-star run
+    The default budget floor is the f32 itemsize even for bf16 inputs:
+    every circulant kernel accumulates its chunk in float32 (distance
+    reduces, weighted sums), and XLA materializes the per-copy f32 upcast
+    of a rolled *float* operand — sizing by itemsize=2 would double the
+    chunk and hand back the OOM headroom the 256-node north-star run
     depends on.
+
+    Compressed-exchange callers pass ``floor=1``: the rolled copies of an
+    int8 payload stay int8 (the dequantizing convert feeds straight into
+    the subtract/FMA chain — there is no standalone f32 copy per roll), so
+    sizing the exchange chunk by the ≥4-byte float assumption would cut
+    the chunk 4x and quadruple the ppermute count for no memory benefit.
     """
-    return max(1, min(p, _CIRCULANT_CHUNK_BYTES // max(1, n * max(itemsize, 4))))
+    return max(
+        1, min(p, _CIRCULANT_CHUNK_BYTES // max(1, n * max(itemsize, floor)))
+    )
 
 
 def _p_chunked_accumulate(arrays, chunk_fn, acc_init, p: int, chunk: int):
@@ -285,8 +313,150 @@ def _p_chunked_map(arrays, chunk_fn, out_dtype, p: int, chunk: int):
     return out
 
 
+def _quantized_pad_own(own, p_pad: int) -> jnp.ndarray:
+    """Float own-side operand padded (with exact zeros) to the payload's
+    block-padded width — the int8 codec's zero padding dequantizes to
+    exact zeros, so both sides' padded columns are inert."""
+    own32 = own.astype(jnp.float32)
+    if own32.shape[1] == p_pad:
+        return own32
+    return jnp.pad(own32, ((0, 0), (0, p_pad - own32.shape[1])))
+
+
+def _quantized_circulant_d2(own, qb: Int8Blocks, offsets) -> jnp.ndarray:
+    """[k, N] squared neighbor distances over a compressed broadcast.
+
+    Each roll moves the int8 payload + the [*, C] scale rows (boundary
+    ppermutes of the COMPRESSED representation on a sharded node axis —
+    MUR700); dequantization fuses into the subtract/square/reduce chain,
+    so HBM serves int8 too.  Chunking runs in whole quant blocks so the
+    scales slice consistently with the payload, sized with ``floor=1``
+    (the compressed-itemsize rationale on :func:`_p_chunk_len`).
+    """
+    n = qb.num_nodes
+    blk, nblocks, p_pad = qb.block, qb.num_blocks, qb.padded_p
+    own_is_q = isinstance(own, Int8Blocks)
+    own_f = None if own_is_q else _quantized_pad_own(own, p_pad)
+
+    def chunk_d2(b0, nb):
+        qc = qb.slice_blocks(b0, nb)
+        if own_is_q:
+            oc = own.slice_blocks(b0, nb).dequantize_f32()
+        else:
+            oc = jax.lax.dynamic_slice(own_f, (0, b0 * blk), (n, nb * blk))
+        return jnp.stack(
+            [
+                jnp.sum(
+                    jnp.square(oc - qc.roll(-o).dequantize_f32()), axis=-1
+                )
+                for o in offsets
+            ]
+        )
+
+    bpc = max(1, _p_chunk_len(n, p_pad, 1, floor=1) // blk)
+    if bpc >= nblocks:
+        return chunk_d2(0, nblocks)
+    nfull = nblocks // bpc
+
+    def body(i, acc):
+        return acc + chunk_d2(i * bpc, bpc)
+
+    acc = jax.lax.fori_loop(
+        0, nfull, body, jnp.zeros((len(offsets), n), jnp.float32)
+    )
+    if nblocks - nfull * bpc:
+        acc = acc + chunk_d2(nfull * bpc, nblocks - nfull * bpc)
+    return acc
+
+
+def _quantized_circulant_weighted_sum(
+    qb: Int8Blocks, w_k: jnp.ndarray, offsets, out_dtype
+) -> jnp.ndarray:
+    """Compressed twin of :func:`circulant_weighted_sum`: the rolled
+    operands are the int8 payload + scales, the f32 weight products
+    accumulate per chunk, and only the [N, p] output materializes in
+    ``out_dtype``."""
+    n = qb.num_nodes
+    blk, nblocks, p_pad = qb.block, qb.num_blocks, qb.padded_p
+    out_dtype = qb.out_dtype if out_dtype is None else out_dtype
+
+    def chunk_sum(b0, nb):
+        qc = qb.slice_blocks(b0, nb)
+        acc = jnp.zeros((n, nb * blk), jnp.float32)
+        for idx, o in enumerate(offsets):
+            acc = acc + w_k[idx][:, None] * qc.roll(-o).dequantize_f32()
+        return acc
+
+    bpc = max(1, _p_chunk_len(n, p_pad, 1, floor=1) // blk)
+    if bpc >= nblocks:
+        return chunk_sum(0, nblocks)[:, : qb.p].astype(out_dtype)
+    nfull = nblocks // bpc
+    out = jnp.zeros((n, p_pad), out_dtype)
+
+    def body(i, out):
+        return jax.lax.dynamic_update_slice(
+            out, chunk_sum(i * bpc, bpc).astype(out_dtype), (0, i * bpc * blk)
+        )
+
+    out = jax.lax.fori_loop(0, nfull, body, out)
+    if nblocks - nfull * bpc:
+        out = jax.lax.dynamic_update_slice(
+            out,
+            chunk_sum(nfull * bpc, nblocks - nfull * bpc).astype(out_dtype),
+            (0, nfull * bpc * blk),
+        )
+    return out[:, : qb.p]
+
+
+def _quantized_circulant_candidate_map(
+    own, qb: Int8Blocks, offsets, fn
+) -> jnp.ndarray:
+    """Compressed twin of :func:`circulant_candidate_map`: the candidate
+    stack is assembled from rolled int8 payloads dequantized per chunk
+    (the stack itself is f32 in registers/VMEM — only the reads are
+    compressed), with the budget scaled by the stack height."""
+    n = qb.num_nodes
+    blk, nblocks, p_pad = qb.block, qb.num_blocks, qb.padded_p
+    own_f = _quantized_pad_own(own, p_pad)
+    out_dtype = qb.out_dtype
+
+    def chunk_apply(b0, nb):
+        qc = qb.slice_blocks(b0, nb)
+        oc = jax.lax.dynamic_slice(own_f, (0, b0 * blk), (n, nb * blk))
+        return fn(
+            jnp.stack(
+                [oc] + [qc.roll(-o).dequantize_f32() for o in offsets]
+            )
+        )
+
+    # The f32 stack dominates the working set, so size by the float
+    # accounting (floor=4) scaled by the stack height, in whole blocks.
+    stack = len(offsets) + 1
+    bpc = max(1, _p_chunk_len(n * stack, p_pad, 4) // blk)
+    if bpc >= nblocks:
+        return chunk_apply(0, nblocks)[:, : qb.p].astype(out_dtype)
+    nfull = nblocks // bpc
+    out = jnp.zeros((n, p_pad), out_dtype)
+
+    def body(i, out):
+        return jax.lax.dynamic_update_slice(
+            out,
+            chunk_apply(i * bpc, bpc).astype(out_dtype),
+            (0, i * bpc * blk),
+        )
+
+    out = jax.lax.fori_loop(0, nfull, body, out)
+    if nblocks - nfull * bpc:
+        out = jax.lax.dynamic_update_slice(
+            out,
+            chunk_apply(nfull * bpc, nblocks - nfull * bpc).astype(out_dtype),
+            (0, nfull * bpc * blk),
+        )
+    return out[:, : qb.p]
+
+
 def circulant_neighbor_distances(
-    own: jnp.ndarray, bcast: jnp.ndarray, offsets
+    own: jnp.ndarray, bcast: jnp.ndarray, offsets, pallas: bool = False
 ) -> jnp.ndarray:
     """[k, N] distances D[o, i] = ||own_i - bcast[(i+o) % N]|| via circular
     shifts — the O(degree) counterpart of the [N, N] pairwise matrix for
@@ -303,7 +473,30 @@ def circulant_neighbor_distances(
     P is associative, so partial sums over chunks accumulate in the same
     f32 precision and only the final sqrt changes position — identical up
     to f32 summation order.
+
+    Compressed exchange (``bcast`` — or both operands — an
+    :class:`Int8Blocks` payload) dispatches to the quantized twin so the
+    rolls move the compressed representation (MUR700); ``pallas=True``
+    routes plain float operands through the fused Pallas streaming kernel
+    (ops/pallas_agg.py) when the shapes fit its envelope.
     """
+    if isinstance(bcast, Int8Blocks):
+        # own may be float (node-local, uncompressed) or Int8Blocks (the
+        # krum delta-distance call passes the payload on both sides).
+        return jnp.sqrt(_quantized_circulant_d2(own, bcast, offsets))
+    if isinstance(own, Int8Blocks):
+        raise TypeError(
+            "circulant_neighbor_distances got a compressed own-side "
+            "operand with an uncompressed broadcast — the quantized twin "
+            "needs the rolled (broadcast) side compressed; quantize both "
+            "or neither"
+        )
+    if pallas:
+        from murmura_tpu.ops import pallas_agg
+
+        d2p = pallas_agg.circulant_sq_distances(own, bcast, offsets)
+        if d2p is not None:
+            return jnp.sqrt(jnp.maximum(d2p, 0.0))
     n, p = bcast.shape
 
     def chunk_d2(oc, bc):
@@ -350,7 +543,12 @@ def circulant_weighted_sum(
     the result (geometric median) pass the resident param dtype here so a
     bf16 256-node program does not materialize f32 [N, P] buffers — the
     6.3 GB-per-copy OOM class.
+
+    A compressed broadcast (:class:`Int8Blocks`) dispatches to the
+    quantized twin: the rolls move int8 + scales (MUR700).
     """
+    if isinstance(bcast, Int8Blocks):
+        return _quantized_circulant_weighted_sum(bcast, w_k, offsets, out_dtype)
     n, p = bcast.shape
     acc_dtype = jnp.result_type(bcast.dtype, w_k.dtype)
     if out_dtype is None:
@@ -400,7 +598,13 @@ def circulant_candidate_map(own, bcast, offsets, fn) -> jnp.ndarray:
     with the budget scaled by the stack height m, so the median and
     trimmed-mean circulant paths never materialize the full [m, N, P]
     tensor (the same OOM class ``_CIRCULANT_CHUNK_BYTES`` exists for).
+
+    A compressed broadcast (:class:`Int8Blocks`) dispatches to the
+    quantized twin: the stack is assembled from rolled int8 payloads.
     """
+    if isinstance(bcast, Int8Blocks):
+        return _quantized_circulant_candidate_map(own, bcast, offsets, fn)
+
     def chunk_apply(oc, bc):
         return fn(jnp.stack([oc] + [jnp.roll(bc, -o, axis=0) for o in offsets]))
 

@@ -81,4 +81,8 @@ def make_fedavg(
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"ppermute"},
         },
+        # Compressed exchange: the circulant path touches the broadcast
+        # only through the shared roll kernels, which move the int8
+        # payload (MUR700).
+        quantized_exchange=offsets is not None,
     )

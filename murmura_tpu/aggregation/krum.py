@@ -44,6 +44,7 @@ def make_krum(
     max_candidates: int = None,
     exchange_offsets: Optional[Sequence[int]] = None,
     sparse_exchange: bool = False,
+    pallas: bool = False,
     **_params,
 ) -> AggregatorDef:
     c = int(num_compromised)
@@ -51,6 +52,7 @@ def make_krum(
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
     if sparse_exchange and offsets is None:
         raise ValueError("sparse_exchange requires exchange_offsets")
+    pallas = bool(pallas)  # ops/pallas_agg.py fused distance kernels
 
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
         """O(degree) Krum for circulant graphs (tpu.exchange: ppermute).
@@ -81,11 +83,15 @@ def make_krum(
         # +0.0 terms and where(True, ...) selections are exact).
         ok = c < (m - 2) / 2
 
-        own_d = circulant_neighbor_distances(own, bcast, offsets)  # [k, N]
+        own_d = circulant_neighbor_distances(
+            own, bcast, offsets, pallas=pallas
+        )  # [k, N]
         deltas = sorted(
             {abs(o2 - o1) for o1 in offsets for o2 in offsets if o1 != o2}
         )
-        bcast_d = circulant_neighbor_distances(bcast, bcast, deltas)  # [D, N]
+        bcast_d = circulant_neighbor_distances(
+            bcast, bcast, deltas, pallas=pallas
+        )  # [D, N]
         didx = {d: i for i, d in enumerate(deltas)}
 
         # [m, m, N] candidate-pair distances per node, assembled from the
@@ -165,8 +171,10 @@ def make_krum(
     def aggregate(own, bcast, adj, round_idx, state, ctx: AggContext):
         n = own.shape[0]
         m_cap = n if mc is None else min(mc, n)
-        d_bcast = pairwise_l2_distances(bcast)
-        d_own = pairwise_l2_distances(own, bcast)  # [i, j] = ||own_i - bcast_j||
+        d_bcast = pairwise_l2_distances(bcast, pallas=pallas)
+        d_own = pairwise_l2_distances(
+            own, bcast, pallas=pallas
+        )  # [i, j] = ||own_i - bcast_j||
 
         cand_idx, valid = candidate_indices(adj, m_cap)  # [N, m] each
         pair_eye = jnp.eye(m_cap, dtype=bool)
@@ -235,4 +243,8 @@ def make_krum(
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"ppermute"},
         },
+        # Compressed exchange: the circulant path touches the broadcast
+        # only through the shared roll kernels, which move the int8
+        # payload (MUR700).
+        quantized_exchange=offsets is not None,
     )

@@ -29,6 +29,7 @@ from murmura_tpu.aggregation.base import (
     circulant_weighted_sum,
     pairwise_l2_distances,
 )
+from murmura_tpu.ops.compress import Int8Blocks
 
 
 def _dense_candidate_map(own, bcast, adj, m_cap, fn):
@@ -66,6 +67,7 @@ def make_coordinate_median(
     max_candidates: Optional[int] = None,
     exchange_offsets: Optional[Sequence[int]] = None,
     sparse_exchange: bool = False,
+    pallas: bool = False,
     **_params,
 ) -> AggregatorDef:
     """Coordinate-wise median over own + neighbor states.
@@ -142,11 +144,29 @@ def make_coordinate_median(
                 "num_candidates": cnt.astype(jnp.float32)
             }
 
-        def coord_median(cand):  # [m, N, c] -> [N, c], all candidates valid
-            ranked = jnp.sort(cand, axis=0)
-            return 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
+        from murmura_tpu.ops import pallas_agg
 
-        new_flat = circulant_candidate_map(own, bcast, offsets, coord_median)
+        # Static trace-time predicate (shape/envelope facts only) — the
+        # taint pass cannot see through the helper's array params.
+        if (  # murmura: ignore[MUR001]
+            pallas
+            and not isinstance(bcast, Int8Blocks)
+            and pallas_agg.candidate_select_supported(own, bcast, offsets)
+        ):
+            # Fused Pallas kernel (ops/pallas_agg.py): the candidate stack
+            # is built, sorted, and reduced per VMEM-resident P-chunk —
+            # the static path only (masked/sparse counts are traced).
+            new_flat = pallas_agg.fused_candidate_select(
+                own, bcast, offsets, median=True
+            )
+        else:
+            def coord_median(cand):  # [m, N, c] -> [N, c], all valid
+                ranked = jnp.sort(cand, axis=0)
+                return 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
+
+            new_flat = circulant_candidate_map(
+                own, bcast, offsets, coord_median
+            )
         return new_flat, state, {
             "num_candidates": jnp.full((n,), float(m), jnp.float32)
         }
@@ -160,6 +180,9 @@ def make_coordinate_median(
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"ppermute"},
         },
+        # Compressed exchange: the circulant candidate stacks read the
+        # broadcast only through the shared roll kernels (MUR700).
+        quantized_exchange=offsets is not None,
     )
 
 
@@ -168,6 +191,7 @@ def make_trimmed_mean(
     max_candidates: Optional[int] = None,
     exchange_offsets: Optional[Sequence[int]] = None,
     sparse_exchange: bool = False,
+    pallas: bool = False,
     **_params,
 ) -> AggregatorDef:
     """Coordinate-wise beta-trimmed mean: drop the floor(beta*cnt) smallest
@@ -254,11 +278,30 @@ def make_trimmed_mean(
 
         trim = int(beta * m)  # static: every node has exactly m candidates
 
-        def coord_trimmed(cand):  # [m, N, c] -> [N, c]
-            ranked = jnp.sort(cand, axis=0)
-            return ranked[trim : m - trim].mean(axis=0)  # m-2*trim >= 1
+        from murmura_tpu.ops import pallas_agg
 
-        new_flat = circulant_candidate_map(own, bcast, offsets, coord_trimmed)
+        # Static trace-time predicate (shape/envelope facts only) — the
+        # taint pass cannot see through the helper's array params.
+        if (  # murmura: ignore[MUR001]
+            pallas
+            and not isinstance(bcast, Int8Blocks)
+            and pallas_agg.candidate_select_supported(
+                own, bcast, offsets, trim=trim
+            )
+        ):
+            # Fused Pallas kernel: sort + trim + mean per VMEM chunk (the
+            # static path only — sparse trim depths are traced).
+            new_flat = pallas_agg.fused_candidate_select(
+                own, bcast, offsets, trim=trim, median=False
+            )
+        else:
+            def coord_trimmed(cand):  # [m, N, c] -> [N, c]
+                ranked = jnp.sort(cand, axis=0)
+                return ranked[trim : m - trim].mean(axis=0)  # m-2*trim >= 1
+
+            new_flat = circulant_candidate_map(
+                own, bcast, offsets, coord_trimmed
+            )
         return new_flat, state, {
             "num_candidates": jnp.full((n,), float(m), jnp.float32),
             "trimmed_per_side": jnp.full((n,), float(trim), jnp.float32),
@@ -273,6 +316,9 @@ def make_trimmed_mean(
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"ppermute"},
         },
+        # Compressed exchange: the circulant candidate stacks read the
+        # broadcast only through the shared roll kernels (MUR700).
+        quantized_exchange=offsets is not None,
     )
 
 
@@ -282,6 +328,7 @@ def make_geometric_median(
     max_candidates: Optional[int] = None,
     exchange_offsets: Optional[Sequence[int]] = None,
     sparse_exchange: bool = False,
+    pallas: bool = False,
     **_params,
 ) -> AggregatorDef:
     """Geometric median via smoothed Weiszfeld iterations (RFA,
@@ -375,7 +422,7 @@ def make_geometric_median(
             # bcast first lets XLA hoist its centered copy out of the
             # Weiszfeld iterations (z's cluster stays near bcast's, so the
             # cancellation guard is equally served); [j, i] -> transpose.
-            d_nb = pairwise_l2_distances(bcast, z).T  # [N, N]
+            d_nb = pairwise_l2_distances(bcast, z, pallas=pallas).T  # [N, N]
             return d_self, d_nb
 
         ones_n = jnp.ones((n,), jnp.float32)
@@ -443,7 +490,9 @@ def make_geometric_median(
             d_self = jnp.sqrt(
                 jnp.square((own - z).astype(jnp.float32)).sum(axis=-1)
             )  # [N]
-            d_k = circulant_neighbor_distances(z, bcast, offsets)  # [k, N]
+            d_k = circulant_neighbor_distances(
+                z, bcast, offsets, pallas=pallas
+            )  # [k, N]
             return d_self, d_k
 
         ones_k = edge_w if sparse_exchange else jnp.ones((k, n), jnp.float32)
@@ -487,4 +536,7 @@ def make_geometric_median(
             "dense": {"all_gather", "all_reduce"},
             "circulant": {"ppermute"},
         },
+        # Compressed exchange: the circulant candidate stacks read the
+        # broadcast only through the shared roll kernels (MUR700).
+        quantized_exchange=offsets is not None,
     )

@@ -41,11 +41,13 @@ def make_ubar(
     min_neighbors: int = 1,
     exchange_offsets: Optional[Sequence[int]] = None,
     sparse_exchange: bool = False,
+    pallas: bool = False,
     **_params,
 ) -> AggregatorDef:
     offsets = None if exchange_offsets is None else [int(o) for o in exchange_offsets]
     if sparse_exchange and offsets is None:
         raise ValueError("sparse_exchange requires exchange_offsets")
+    pallas = bool(pallas)  # ops/pallas_agg.py fused distance kernels
 
     def aggregate_circulant(own, bcast, adj, round_idx, state, ctx: AggContext):
         """O(degree) path (tpu.exchange: ppermute): distances, the stage-2
@@ -60,7 +62,9 @@ def make_ubar(
         # degree (and therefore the shortlist size) is a traced value —
         # the floor runs in f32 instead of Python float, which agrees with
         # int(rho * k) for every non-pathological (rho, k).
-        d_nk = circulant_neighbor_distances(own, bcast, offsets).T  # [N, k]
+        d_nk = circulant_neighbor_distances(
+            own, bcast, offsets, pallas=pallas
+        ).T  # [N, k]
         if sparse_exchange:
             edge_b = adj.T > 0  # [N, k] receiver-side active-edge mask
             deg = adj.sum(axis=0)  # [N]
@@ -128,7 +132,7 @@ def make_ubar(
         degree = adj.sum(axis=1)
 
         # Stage 1: rho * degree closest neighbors (ubar.py:133-139).
-        dist = pairwise_l2_distances(own, bcast)
+        dist = pairwise_l2_distances(own, bcast, pallas=pallas)
         num_select = jnp.maximum(min_neighbors, (rho * degree).astype(jnp.int32))
         shortlist = rank_mask(dist, adj_b, num_select)
 

@@ -39,6 +39,15 @@ BUDGET_MODEL_DIMS: Tuple[int, ...] = (256, 1024)
 BUDGET_DTYPE = "float32"
 TOLERANCE = 0.10
 
+# Fused Pallas aggregation kernels (ops/pallas_agg.py): the circulant
+# cells of these rules are additionally measured with the kernels armed
+# (mode "pallas"), so the fused formulation's FLOP/bytes delta vs the lax
+# circulant cells is committed, reviewable perf history.  On CPU the
+# kernels run interpreted — the numbers track the interpreter's lowering,
+# which is stable for a fixed jax build (same contract as every other
+# cell).
+PALLAS_BUDGET_RULES: Tuple[str, ...] = ("krum", "median", "trimmed_mean")
+
 
 def normalize_cost_analysis(cost) -> Dict[str, float]:
     """Flatten the cross-version shapes of ``Compiled.cost_analysis()``
@@ -63,14 +72,20 @@ def _cpu_device():
 
 
 def measure_cell(
-    name: str, n: int, circulant: bool, dim: Optional[int] = None
+    name: str, n: int, circulant: bool, dim: Optional[int] = None,
+    pallas: bool = False,
 ) -> Dict[str, float]:
     """AOT-compile one canonical cell on CPU and read XLA's cost model."""
     import jax
 
     from murmura_tpu.analysis import ir
 
-    prog = ir.build_canonical(name, n, BUDGET_DTYPE, circulant, dim=dim)
+    params = (
+        dict(ir.AGG_CASES.get(name, {}), pallas=True) if pallas else None
+    )
+    prog = ir.build_canonical(
+        name, n, BUDGET_DTYPE, circulant, dim=dim, params=params
+    )
     dev = _cpu_device()
     cm = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
     with cm:
@@ -143,6 +158,17 @@ def measure_all(force: bool = False) -> Dict[str, Dict[str, float]]:
                     )
                     try:
                         out[key] = measure_cell(name, n, circulant, dim=dim)
+                    except Exception as e:  # noqa: BLE001 — cell error
+                        out[key] = {"error": f"{type(e).__name__}: {e}"}
+                if name in PALLAS_BUDGET_RULES:
+                    # The fused-kernel circulant cell (mode "pallas"), so
+                    # the kernel formulation's cost delta is committed
+                    # perf history next to the lax cells.
+                    key = budget_key(name, n, dim, "pallas")
+                    try:
+                        out[key] = measure_cell(
+                            name, n, True, dim=dim, pallas=True
+                        )
                     except Exception as e:  # noqa: BLE001 — cell error
                         out[key] = {"error": f"{type(e).__name__}: {e}"}
     _MEASURE_MEMO = dict(out)

@@ -1147,6 +1147,264 @@ def check_sparse_exchange() -> List[Finding]:
     return findings
 
 
+# Rules whose circulant/sparse exchange accepts the int8 compressed
+# payload (AggregatorDef.quantized_exchange — they touch the broadcast
+# only through the shared roll kernels).  MUR700 runs over the flagship
+# subset; the remaining quantized rules share the same kernels, so the
+# payload contract transfers.
+QUANTIZED_EXCHANGE_RULES: Tuple[str, ...] = (
+    "fedavg", "krum", "balance", "median", "trimmed_mean",
+    "geometric_median",
+)
+MUR700_RULES: Tuple[str, ...] = ("fedavg", "krum", "median")
+_COMPRESS_BLOCK = 64
+
+# Only lines whose OPCODE is a collective (`= <shape> <op>(...)`), not
+# every line that references a collective's result name as a fusion
+# operand; the operand shapes inside the parens are what crosses the wire.
+_COLL_OP_LINE_RE = re.compile(
+    r"^.*=\s*\S+\s+(?:collective-permute|all-gather|all-to-all|"
+    r"reduce-scatter)(?:-start)?\((.*)$",
+    re.MULTILINE,
+)
+_FLOAT_SHAPE_RE = re.compile(r"\b(f32|bf16|f64)\[([0-9,]*)\]")
+
+
+def float_exchange_operands(hlo_text: str, width: int):
+    """(offending floats, collective operand strings) of an HLO module:
+    floating shapes of exchanged width (any dim >= ``width`` — boundary
+    roll slices are [o, P]) appearing in collective ops.  The MUR700 scan,
+    factored out so its negatives are unit-testable
+    (tests/test_analysis_ir.py)."""
+    coll_lines = _COLL_OP_LINE_RE.findall(hlo_text)
+    offending = sorted({
+        m.group(0)
+        for ln in coll_lines
+        for m in _FLOAT_SHAPE_RE.finditer(ln)
+        if any(
+            d >= width for d in (int(x) for x in m.group(2).split(",") if x)
+        )
+    })
+    return offending, coll_lines
+
+
+def check_compressed_exchange() -> List[Finding]:
+    """MUR700/701/702: the compressed exchange moves compressed bytes and
+    is IR-inert (docs/PERFORMANCE.md; ops/compress.py).
+
+    MUR700 — the compressed payload is what crosses the collective: each
+    MUR700_RULES cell is compiled with the node axis sharded and an int8
+    payload standing in for the broadcast; no collective in the lowered
+    SPMD program may carry a floating operand of exchanged width (a dim >=
+    the flat model dimension — boundary roll slices are [o, P]), and at
+    least one int8 collective must be present (the positive control that
+    keeps the scan non-vacuous).  Runs in circulant and sparse modes; the
+    dense path is documented as values-compressed only (the gathered
+    matmul operand is the dequantized tensor).
+
+    MUR701 — compression is recompile-free across rounds: an int8 +
+    error-feedback round program compiles once and rounds with different
+    adjacency values reuse the executable (CompileTracker) — scales,
+    residuals and reference estimates are traced values, never structure.
+
+    MUR702 — the error-feedback state is donation-clean: the compressed
+    round step's donated buffers (params + agg_state including the [N, P]
+    residual) are all aliased in the compiled executable; a lost alias
+    would cost a full extra [N, P] copy per round.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.aggregation.base import AggContext
+    from murmura_tpu.analysis.sanitizers import RecompileError, track_compiles
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.base import FederatedArrays
+    from murmura_tpu.models import make_mlp
+    from murmura_tpu.ops.compress import (
+        CompressionSpec,
+        Int8Blocks,
+        quantize_int8,
+    )
+
+    findings: List[Finding] = []
+    n = IR_NODE_COUNTS[1]  # 12: distinct from the probe batch and P dims
+    dim = IR_MODEL_DIM
+
+    # -- MUR700 ------------------------------------------------------------
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devices = jax.devices()
+    usable = [c for c in (2, 4) if c <= len(devices) and n % c == 0]
+    if not usable:
+        warnings.warn(
+            "murmura check --ir: fewer than 2 devices available — the "
+            "MUR700 compressed-payload inventory is unobservable on this "
+            "platform (run under "
+            "XLA_FLAGS=--xla_force_host_platform_device_count=8)",
+            stacklevel=2,
+        )
+    else:
+        mesh = Mesh(np.array(devices[: max(usable)]), ("nodes",))
+        node_s = NamedSharding(mesh, P("nodes"))
+        repl = NamedSharding(mesh, P())
+        edge_s = NamedSharding(mesh, P(None, "nodes"))
+        for name in MUR700_RULES:
+            path, line = _rule_anchor(name)
+            for mode in ("circulant", "sparse"):
+                try:
+                    rng = np.random.default_rng(0)
+                    case = dict(AGG_CASES[name])
+                    offsets = canonical_offsets(n)
+                    case["exchange_offsets"] = offsets
+                    if mode == "sparse":
+                        case["sparse_exchange"] = True
+                    agg = build_aggregator(
+                        name, case, model_dim=dim, total_rounds=10
+                    )
+                    own = jnp.asarray(
+                        rng.normal(size=(n, dim)) * 0.1, jnp.float32
+                    )
+                    bcast = jnp.asarray(
+                        rng.normal(size=(n, dim)) * 0.1, jnp.float32
+                    )
+                    qb = quantize_int8(bcast, _COMPRESS_BLOCK)
+                    if mode == "sparse":
+                        adj = jnp.ones((len(offsets), n), jnp.float32)
+                        adj_s = edge_s
+                    else:
+                        adj = jnp.asarray(_canonical_adj(n, circulant=True))
+                        adj_s = node_s
+                    state = {
+                        k: jnp.asarray(v)
+                        for k, v in agg.init_state(n).items()
+                    }
+                    ctx = AggContext(
+                        total_rounds=10, num_classes=_PROBE_CLASSES,
+                        node_axis_sharded=True,
+                    )
+
+                    def fn(own, q, scale, adj, ridx, state):  # murmura: traced
+                        qv = Int8Blocks(
+                            q, scale, _COMPRESS_BLOCK, dim, jnp.float32
+                        )
+                        return agg.aggregate(own, qv, adj, ridx, state, ctx)
+
+                    args = (
+                        own, qb.q, qb.scale, adj,
+                        jnp.asarray(0.0, jnp.float32), state,
+                    )
+                    in_s = (
+                        node_s, node_s, node_s, adj_s, repl,
+                        {k: node_s for k in state},
+                    )
+                    # One-shot analysis compile per cell, not a hot path
+                    # (the MUR204 pattern).
+                    jitted = jax.jit(fn, in_shardings=in_s)  # murmura: ignore[MUR004]
+                    txt = jitted.lower(*args).compile().as_text()
+                except Exception as e:  # noqa: BLE001 — a crash IS the finding
+                    findings.append(Finding(
+                        "MUR700", path, line,
+                        f"aggregator '{name}' ({mode}) crashed the "
+                        f"compressed-payload sweep: {type(e).__name__}: {e}",
+                    ))
+                    continue
+                offending, coll_lines = float_exchange_operands(txt, dim)
+                if offending:
+                    findings.append(Finding(
+                        "MUR700", path, line,
+                        f"aggregator '{name}' ({mode}, compressed) moves "
+                        f"full-width float operand(s) {offending[:4]} "
+                        "through a collective — the compressed int8 "
+                        "payload (plus per-block scales) is what must "
+                        "cross; dequantize after the roll, not before",
+                    ))
+                if coll_lines and not any("s8[" in ln for ln in coll_lines):
+                    findings.append(Finding(
+                        "MUR700", path, line,
+                        f"aggregator '{name}' ({mode}, compressed) lowers "
+                        "to no int8 collective at all — the payload scan "
+                        "is vacuous; the exchange no longer moves the "
+                        "compressed representation",
+                    ))
+
+    # -- MUR701 / MUR702 over a full compressed round program ---------------
+    pkg = Path(__file__).resolve().parent.parent
+    anchor = str(pkg / "core" / "rounds.py")
+    n4, s = 4, 16
+    rng = np.random.default_rng(0)
+    data = FederatedArrays(
+        x=rng.normal(size=(n4, s, _PROBE_IN)).astype(np.float32),
+        y=rng.integers(0, _PROBE_CLASSES, size=(n4, s)).astype(np.int32),
+        mask=np.ones((n4, s), np.float32),
+        num_samples=np.full((n4,), s),
+        num_classes=_PROBE_CLASSES,
+    )
+    model = make_mlp(
+        input_dim=_PROBE_IN, hidden_dims=(16,), num_classes=_PROBE_CLASSES
+    )
+    agg = build_aggregator(
+        "fedavg", {}, model_dim=_probe_model()[2], total_rounds=5
+    )
+    spec = CompressionSpec("int8", block=_COMPRESS_BLOCK, error_feedback=True)
+    prog = build_round_program(
+        model, agg, data, total_rounds=5, batch_size=8, compression=spec
+    )
+    d = {k: jnp.asarray(v) for k, v in prog.data_arrays.items()}
+
+    def args_for(adj_seed: int, r: int):
+        rng_a = np.random.default_rng(adj_seed)
+        adj = (rng_a.uniform(size=(n4, n4)) < 0.8).astype(np.float32)
+        np.fill_diagonal(adj, 0.0)
+        return (
+            prog.init_params,
+            {k: jnp.asarray(v) for k, v in prog.init_agg_state.items()},
+            jax.random.PRNGKey(r),
+            jnp.asarray(adj),
+            jnp.zeros((n4,), jnp.float32),
+            jnp.asarray(float(r), jnp.float32),
+            d,
+        )
+
+    # One-shot analysis compile, not a hot path (the MUR204 pattern).
+    step = jax.jit(prog.train_step)  # murmura: ignore[MUR004]
+    try:
+        with track_compiles() as tracker:
+            tracker.begin("warmup")
+            jax.block_until_ready(step(*args_for(0, 0))[0])
+            tracker.end(allow=True)
+            for r in (1, 2):
+                tracker.begin(f"round {r}")
+                jax.block_until_ready(step(*args_for(r, r))[0])
+                tracker.end(allow=False)
+    except RecompileError as e:
+        findings.append(Finding(
+            "MUR701", anchor, 1,
+            f"varying round inputs recompiled the compressed round step "
+            f"({e}) — scales, residuals and reference estimates must reach "
+            "the program as traced values, never as structure",
+        ))
+
+    args = args_for(0, 0)
+    donated = len(jax.tree_util.tree_leaves(args[0])) + len(
+        jax.tree_util.tree_leaves(args[1])
+    )
+    # One-shot analysis compile, not a hot path (the MUR204 pattern).
+    dstep = jax.jit(prog.train_step, donate_argnums=(0, 1))  # murmura: ignore[MUR004]
+    txt = dstep.lower(*args).compile().as_text()
+    aliased = len(_ALIAS_RE.findall(txt))
+    if aliased < donated:
+        findings.append(Finding(
+            "MUR702", anchor, 1,
+            f"compressed round step: only {aliased} of {donated} donated "
+            "buffers (params + agg_state including the error-feedback "
+            "residual) are aliased in the compiled executable — the rest "
+            "pay a full extra copy per round despite donate_argnums=(0, 1)",
+        ))
+    return findings
+
+
 # Rules that surface per-node audit taps under telemetry.audit_taps
 # (tap_* stats).  MUR400/402 run over exactly this set; a new tapped rule
 # joins the contract by being added here.
@@ -1431,6 +1689,15 @@ def check_ir(force: bool = False) -> List[Finding]:
         findings.append(Finding(
             "MUR600", str(pkg / "core" / "rounds.py"), 1,
             f"the sparse-exchange IR contracts crashed: "
+            f"{type(e).__name__}: {e}",
+        ))
+    try:
+        findings.extend(check_compressed_exchange())
+    except Exception as e:  # noqa: BLE001 — a crash IS the finding
+        pkg = Path(__file__).resolve().parent.parent
+        findings.append(Finding(
+            "MUR700", str(pkg / "core" / "rounds.py"), 1,
+            f"the compressed-exchange IR contracts crashed: "
             f"{type(e).__name__}: {e}",
         ))
 

@@ -190,6 +190,56 @@ class FaultsConfig(_Strict):
     )
 
 
+class CompressionConfig(_Strict):
+    """Compressed neighbor exchange (murmura_tpu extension; ISSUE 7 —
+    docs/PERFORMANCE.md, PAPERS.md: quantized decentralized SGD,
+    arXiv:1910.12308).
+
+    The round's exchanged [N, P] broadcast is quantized in-jit — per-block
+    int8, or top-k of the round-over-round delta — the exchange moves the
+    compressed representation, and receivers dequantize before rule math.
+    ``error_feedback`` carries the quantization residual in the aggregation
+    state and adds it back to the next round's transmission, the condition
+    under which compressed decentralized SGD converges like full precision.
+
+    Default (``algorithm: none``) => byte-identical behavior to a config
+    without this block: the compiled round program, histories, and random
+    streams are untouched.
+    """
+
+    algorithm: Literal["none", "int8", "topk"] = Field(
+        default="none",
+        description=(
+            "Exchange codec: none (full-precision, the default), int8 "
+            "(per-block symmetric 8-bit quantization of the broadcast), or "
+            "topk (sparse top-k delta against a carried reference estimate)"
+        ),
+    )
+    error_feedback: bool = Field(
+        default=False,
+        description=(
+            "Carry the quantization residual (update - dequant(quant)) in "
+            "agg_state and add it back to next round's transmission, so "
+            "compression error telescopes instead of accumulating"
+        ),
+    )
+    block: int = Field(
+        default=256, ge=8,
+        description=(
+            "int8 quantization block along the parameter axis (one f32 "
+            "scale per block; smaller blocks = finer scales, more scale "
+            "bytes)"
+        ),
+    )
+    topk_ratio: float = Field(
+        default=0.05, gt=0.0, le=1.0,
+        description=(
+            "Fraction of the [P] coordinates the topk codec transmits per "
+            "round (values + int32 indices)"
+        ),
+    )
+
+
 class TelemetryConfig(_Strict):
     """Unified runtime telemetry (murmura_tpu extension; ISSUE 4 —
     docs/OBSERVABILITY.md).
@@ -567,6 +617,17 @@ class TPUConfig(_Strict):
     profile_dir: Optional[str] = Field(
         default=None, description="If set, write a jax.profiler trace here"
     )
+    pallas_agg: bool = Field(
+        default=False,
+        description=(
+            "Route the aggregation hot loop's distance/selection passes "
+            "through the fused Pallas TPU kernels (ops/pallas_agg.py): one "
+            "streamed read of the [N, P] broadcast instead of one per "
+            "offset/candidate.  Interpreted (and parity-tested) on CPU; "
+            "ignored on a sharded node axis (pallas_call does not "
+            "decompose under GSPMD).  Env twin: MURMURA_PALLAS_AGG=1."
+        ),
+    )
     recompile_guard: bool = Field(
         default=False,
         description=(
@@ -633,6 +694,13 @@ class Config(_Strict):
         description=(
             "Unified telemetry (run manifest + event stream + audit taps); "
             "default off => byte-identical to no telemetry block"
+        ),
+    )
+    compression: CompressionConfig = Field(
+        default_factory=CompressionConfig,
+        description=(
+            "Compressed neighbor exchange (int8/topk with error feedback); "
+            "default (none) => byte-identical to no compression block"
         ),
     )
     sweep: Optional[SweepConfig] = Field(
@@ -788,6 +856,44 @@ class Config(_Strict):
                 "population does not compose with dmtt (trust state is "
                 "keyed by node identity, which cohort swaps reassign)"
             )
+        return self
+
+    @model_validator(mode="after")
+    def _compression_is_wirable(self):
+        c = self.compression
+        if c.algorithm == "none":
+            if c.error_feedback:
+                # Same fail-loud discipline as the telemetry sub-settings:
+                # error feedback without a codec would silently run an
+                # uncompressed exchange while the config *looks* compressed.
+                raise ValueError(
+                    "compression.error_feedback requires a codec "
+                    "(compression.algorithm: int8 or topk)"
+                )
+            return self
+        if self.backend == "distributed":
+            raise ValueError(
+                "compressed exchange runs inside the jitted round program; "
+                "backend: distributed exchanges full states over ZMQ — use "
+                "backend: simulation or tpu"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "compression does not compose with dmtt (claim "
+                "cross-evaluation consumes the uncompressed broadcast)"
+            )
+        if self.population is not None and self.population.enabled:
+            if c.error_feedback or c.algorithm == "topk":
+                # Both the error-feedback residual and the topk reference
+                # estimate are per-slot [N, P] state; cohort swaps reassign
+                # slots to different users, so the carried state would be
+                # fed into the wrong user's stream.  Stateless int8 is fine.
+                raise ValueError(
+                    "compression with carried state (error_feedback, or "
+                    "algorithm: topk) does not compose with population "
+                    "(cohort swaps reassign node slots); use stateless "
+                    "int8 or disable the population block"
+                )
         return self
 
     @model_validator(mode="after")

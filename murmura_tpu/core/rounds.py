@@ -44,6 +44,12 @@ from murmura_tpu.dmtt.protocol import (
     init_dmtt_state,
 )
 from murmura_tpu.models.core import Model
+from murmura_tpu.ops.compress import (
+    COMPRESS_STATE_KEYS,
+    CompressionSpec,
+    compress_exchange,
+    init_compress_state,
+)
 from murmura_tpu.ops.flatten import make_flatteners
 from murmura_tpu.ops.losses import (
     evidential_loss,
@@ -96,6 +102,13 @@ class RoundProgram:
     # nothing O(N^2) enters the lowered HLO (MUR600).  () => byte-identical
     # to pre-sparse builds.
     sparse_offsets: Tuple[int, ...] = ()
+    # Compressed exchange (ops/compress.py; docs/PERFORMANCE.md): the
+    # broadcast tensor is quantized in-jit before the exchange (int8 blocks
+    # or top-k delta), receivers dequantize before rule math, and the
+    # quantization residual optionally rides ``agg_state`` as error
+    # feedback.  None (default) => the traced program is byte-identical to
+    # pre-compression builds.
+    compression: Optional[CompressionSpec] = None
 
     @property
     def sparse(self) -> bool:
@@ -128,6 +141,7 @@ def build_round_program(
     audit_taps: bool = False,
     hp_inputs: Tuple[str, ...] = (),
     sparse_offsets: Optional[Tuple[int, ...]] = None,
+    compression: Optional[CompressionSpec] = None,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -184,6 +198,13 @@ def build_round_program(
         raise ValueError(
             "sparse exchange mode does not compose with DMTT (claim "
             "verification needs the dense per-round exchange graph)"
+        )
+    if compression is not None and dmtt is not None:
+        raise ValueError(
+            "compressed exchange does not compose with DMTT (the claim "
+            "cross-evaluation consumes the uncompressed broadcast — a "
+            "compressed probe sweep would verify against different models "
+            "than the rules aggregate)"
         )
 
     def _sender_view(vec):  # murmura: traced
@@ -512,6 +533,25 @@ def build_round_program(
         else:
             bcast = own_flat
 
+        # 2c. compressed exchange (ops/compress.py; docs/PERFORMANCE.md):
+        # the outgoing broadcast — post-attack, post-sentinel, so the codec
+        # only ever sees finite values — is quantized in-jit; the rule
+        # receives either the int8 payload (rules whose exchange kernels
+        # move compressed data, AggregatorDef.quantized_exchange) or the
+        # receiver-side dequantized tensor.  Error-feedback residual and
+        # the top-k reference estimate ride ``agg_state`` (same shapes and
+        # dtypes every round: donation-clean, recompile-free — MUR701/702).
+        compress_stats = {}
+        if compression is not None:
+            with jax.named_scope("murmura.compress"):
+                bcast, _decoded, comp_updates, compress_stats = (
+                    compress_exchange(
+                        compression, bcast, agg_state,
+                        agg.quantized_exchange,
+                    )
+                )
+            agg_state = {**agg_state, **comp_updates}
+
         step_ctx = AggContext(
             apply_fn=ctx.apply_fn,
             unravel=ctx.unravel,
@@ -551,7 +591,10 @@ def build_round_program(
             step_ctx = dataclasses.replace(step_ctx, probe_cross=cross)
 
         # 3. adjacency-masked aggregation (network.py:121-139)
-        rule_state = {k: v for k, v in agg_state.items() if k not in DMTT_STATE_KEYS}
+        rule_state = {
+            k: v for k, v in agg_state.items()
+            if k not in DMTT_STATE_KEYS and k not in COMPRESS_STATE_KEYS
+        }
         with jax.named_scope("murmura.aggregate"):
             new_flat, rule_state, agg_stats = agg.aggregate(
                 own_flat, bcast, adj, round_idx, rule_state, step_ctx
@@ -579,6 +622,7 @@ def build_round_program(
         metrics = {f"agg_{k}": v for k, v in agg_stats.items()}
         metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
         metrics.update({f"agg_{k}": v for k, v in fault_stats.items()})
+        metrics.update({f"agg_{k}": v for k, v in compress_stats.items()})
         return params, agg_state, metrics
 
     if faults is None:
@@ -605,6 +649,22 @@ def build_round_program(
         init_agg_state.update(
             {k: np.asarray(v) for k, v in init_dmtt_state(n).items()}
         )
+    if compression is not None:
+        # Error-feedback residual (zeros) and/or the top-k reference
+        # estimate, which adopts the protocol-known initial broadcast (a
+        # real deployment sends full states once at setup) so round 0's
+        # delta is already sparse.  Stored in the resident param dtype —
+        # both shapes are [N, P] and round-stable, so donation aliases hold.
+        clash = set(COMPRESS_STATE_KEYS) & set(init_agg_state)
+        if clash:
+            raise ValueError(
+                f"aggregator '{agg.name}' carries state keys {sorted(clash)}"
+                " reserved for the compressed exchange"
+            )
+        init_flat = np.asarray(jax.vmap(ravel)(init_params))
+        init_agg_state.update(
+            init_compress_state(compression, init_flat, init_flat.dtype)
+        )
 
     return RoundProgram(
         train_step=train_round,
@@ -618,6 +678,7 @@ def build_round_program(
         faulted=faults is not None,
         hp_inputs=hp_inputs,
         sparse_offsets=sparse_offsets,
+        compression=compression,
     )
 
 

@@ -1,0 +1,365 @@
+"""Pallas TPU kernels for the aggregation hot loop: fused distance
+accumulation and candidate selection (docs/PERFORMANCE.md).
+
+BENCH_r02 pins the round at ~1.4% MFU — exchange/aggregation-bound, not
+FLOP-bound.  The aggregation hot loop's HBM traffic is dominated by
+re-reading the [N, P] broadcast tensor: the circulant distance pass reads
+it once per offset (k rolled passes), and the candidate-stack rules
+materialize rolled copies before sorting.  These kernels stream the
+parameter axis through VMEM once and fuse everything downstream of the
+read:
+
+``circulant_sq_distances``
+    [k, N] squared neighbor distances in ONE pass over own/bcast: each
+    [N, C] chunk is loaded once and all k rolled subtract-square-reduce
+    chains run in VMEM — 2·N·P HBM reads instead of (k+1)·N·P.
+
+``pairwise_sq_distances``
+    The dense [N, M] distance matrix (krum/ubar/balance stage 1) with the
+    Gram matmul, the squared norms, and the final combination fused in one
+    streamed pass; the MXU does the per-chunk dot.
+
+``fused_candidate_select``
+    The static circulant median/trimmed-mean: per P-chunk, the [m, N, C]
+    candidate stack is built from rolls in VMEM, sorted along the small
+    static m axis with an odd-even transposition network, and reduced to
+    the median / trimmed mean — the [N, m, P]-class intermediate the lax
+    path sorts over never exists.
+
+Deployment contract (mirrors ``ops/pallas_sketch.py``):
+
+- ``interpret=True`` on non-TPU backends, automatically — the tier-1 suite
+  (pinned to CPU) runs every kernel through the Pallas interpreter, so
+  parity with the lax reference path is tested everywhere
+  (tests/test_pallas_agg.py).
+- Opt-in via ``tpu.pallas_agg: true`` (or ``MURMURA_PALLAS_AGG=1``), wired
+  by the factories as an aggregator param; off by default and never
+  enabled on a sharded node axis (pallas_call does not decompose under
+  GSPMD — the sharded path keeps the lax kernels).
+- Each entry point returns ``None`` when the shapes fall outside the
+  kernel's support envelope (tiling alignment on a real TPU, VMEM budget);
+  callers (aggregation/base.py) fall back to the lax path, so enabling the
+  toggle is always safe.
+- Parity is to documented tolerance, not bit-exact: the kernels accumulate
+  chunk sums in float32 like the lax kernels but group them differently,
+  and candidate stacks are compared/summed in f32 before the final cast.
+
+Budget cells for the kernels land in ``analysis/BUDGETS.json`` under the
+``pallas`` mode (analysis/budgets.py), so the FLOP/bytes delta of the
+fused formulation is committed, reviewable perf history.
+"""
+
+import functools
+import os
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# Per-input-block VMEM budget (bytes).  The distance kernels hold two
+# [N, C] f32 blocks plus the [k, N]/[N, M] accumulator; the candidate
+# kernel holds an m-high stack.  ~16 MB VMEM/core; stay well under.
+_VMEM_BLOCK_BYTES = 4 * 1024 * 1024
+
+# Hard cap on the resident accumulator (pairwise kernel holds [N, M] f32
+# in VMEM for the whole sweep).
+_MAX_PAIRWISE_CELLS = 1024 * 1024
+
+
+def _interpret_default() -> bool:
+    """Interpreter mode everywhere but a real TPU (the test-suite path);
+    MURMURA_PALLAS_INTERPRET=1 forces it for on-chip debugging."""
+    if os.environ.get("MURMURA_PALLAS_INTERPRET") == "1":
+        return True
+    return jax.default_backend() != "tpu"
+
+
+def _chunk_cols(n_rows: int, p: int, copies: int) -> int:
+    """Lane-aligned chunk width so ``copies`` [n_rows, C] f32 blocks fit
+    the VMEM budget."""
+    c = _VMEM_BLOCK_BYTES // max(1, 4 * n_rows * copies)
+    c = max(128, (c // 128) * 128)
+    return min(c, max(128, (-(-p // 128)) * 128))
+
+
+def _pad_cols(x: jnp.ndarray, width: int) -> jnp.ndarray:
+    if x.shape[-1] == width:
+        return x
+    return jnp.pad(x, ((0, 0), (0, width - x.shape[-1])))
+
+
+def _tiling_ok(interpret: bool, *dims) -> bool:
+    """Compiled Mosaic wants sublane-aligned logical rows; the interpreter
+    takes anything.  (Lane dims are always padded to 128 via _chunk_cols /
+    output padding.)"""
+    if interpret:
+        return True
+    return all(d % 8 == 0 for d in dims)
+
+
+# ---------------------------------------------------------------------------
+# circulant fused distances
+# ---------------------------------------------------------------------------
+
+
+def _circ_dist_kernel(own_ref, b_ref, out_ref, *, offsets, k_pad):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    o_blk = own_ref[:].astype(jnp.float32)
+    b_blk = b_ref[:].astype(jnp.float32)
+    rows = []
+    for off in offsets:
+        d = o_blk - jnp.roll(b_blk, -off, axis=0)
+        rows.append(jnp.sum(d * d, axis=1))
+    acc = jnp.stack(rows)
+    if k_pad > len(offsets):
+        acc = jnp.pad(acc, ((0, k_pad - len(offsets)), (0, 0)))
+    out_ref[:] += acc
+
+
+@functools.partial(
+    jax.jit, static_argnames=("offsets", "interpret")
+)
+def _circ_dist_call(own, bcast, offsets, interpret):
+    n, p = bcast.shape
+    k = len(offsets)
+    chunk = _chunk_cols(n, p, 2)
+    p_pad = -(-p // chunk) * chunk
+    # Zero padding is inert: both operands pad identically, so padded
+    # columns contribute (0 - 0)^2 to every distance.
+    own_p = _pad_cols(own.astype(jnp.float32), p_pad)
+    b_p = _pad_cols(bcast.astype(jnp.float32), p_pad)
+    k_pad = k if interpret else -(-k // 8) * 8
+    n_pad = n if interpret else -(-n // 128) * 128
+    if n_pad != n:
+        # Row padding would corrupt the wrap-around of in-kernel rolls;
+        # the caller falls back (see circulant_sq_distances).
+        raise ValueError("unaligned n reached the kernel")
+    out = pl.pallas_call(
+        functools.partial(
+            _circ_dist_kernel, offsets=tuple(offsets), k_pad=k_pad
+        ),
+        grid=(p_pad // chunk,),
+        in_specs=[
+            pl.BlockSpec((n, chunk), lambda i: (0, i)),
+            pl.BlockSpec((n, chunk), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((k_pad, n), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((k_pad, n), jnp.float32),
+        interpret=interpret,
+    )(own_p, b_p)
+    return out[:k]
+
+
+def circulant_sq_distances(
+    own: jnp.ndarray,
+    bcast: jnp.ndarray,
+    offsets: Sequence[int],
+    interpret: Optional[bool] = None,
+) -> Optional[jnp.ndarray]:
+    """[k, N] squared distances D2[o, i] = ||own_i - bcast[(i+o) % N]||^2
+    in one fused streaming pass, or ``None`` when the shapes fall outside
+    the kernel envelope (caller falls back to the lax path)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    n, p = bcast.shape
+    if not offsets or own.shape != bcast.shape:
+        return None
+    # Compiled mode: in-kernel rolls wrap at the block's row count, so the
+    # node dim must be exactly resident (no row padding) and lane-aligned
+    # for the [k, N] output.
+    if not interpret and (n % 128 != 0):
+        return None
+    if not _tiling_ok(interpret, n):
+        return None
+    return _circ_dist_call(own, bcast, tuple(int(o) for o in offsets), interpret)
+
+
+# ---------------------------------------------------------------------------
+# dense fused pairwise distances
+# ---------------------------------------------------------------------------
+
+
+def _pairwise_kernel(a_ref, b_ref, out_ref, g_ref, sa_ref, sb_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        g_ref[:] = jnp.zeros_like(g_ref)
+        sa_ref[:] = jnp.zeros_like(sa_ref)
+        sb_ref[:] = jnp.zeros_like(sb_ref)
+
+    a = a_ref[:].astype(jnp.float32)
+    b = b_ref[:].astype(jnp.float32)
+    g_ref[:] += jax.lax.dot_general(
+        a, b, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    sa_ref[:] += jnp.sum(a * a, axis=1)[None, :]
+    sb_ref[:] += jnp.sum(b * b, axis=1)[None, :]
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        out_ref[:] = (
+            sa_ref[0, :][:, None] + sb_ref[0, :][None, :] - 2.0 * g_ref[:]
+        )
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _pairwise_call(a, b, interpret):
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, p = a.shape
+    m = b.shape[0]
+    chunk = _chunk_cols(max(n, m), p, 2)
+    p_pad = -(-p // chunk) * chunk
+    a_p = _pad_cols(a.astype(jnp.float32), p_pad)
+    b_p = _pad_cols(b.astype(jnp.float32), p_pad)
+    scratch = [
+        pltpu.VMEM((n, m), jnp.float32),
+        pltpu.VMEM((1, n), jnp.float32),
+        pltpu.VMEM((1, m), jnp.float32),
+    ]
+    return pl.pallas_call(
+        _pairwise_kernel,
+        grid=(p_pad // chunk,),
+        in_specs=[
+            pl.BlockSpec((n, chunk), lambda i: (0, i)),
+            pl.BlockSpec((m, chunk), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((n, m), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, m), jnp.float32),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(a_p, b_p)
+
+
+def pairwise_sq_distances(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    interpret: Optional[bool] = None,
+) -> Optional[jnp.ndarray]:
+    """[N, M] squared distances with the Gram matmul and norm combination
+    fused into one streamed pass.  Inputs are expected pre-centered (the
+    caller owns the cancellation guard — aggregation/base.py); returns
+    ``None`` outside the kernel envelope."""
+    if interpret is None:
+        interpret = _interpret_default()
+    n, p = a.shape
+    m = b.shape[0]
+    if b.shape[1] != p:
+        return None
+    if n * m > _MAX_PAIRWISE_CELLS:
+        return None  # the [N, M] accumulator must stay VMEM-resident
+    if not interpret and (n % 8 != 0 or m % 128 != 0):
+        return None
+    return _pairwise_call(a, b, interpret)
+
+
+# ---------------------------------------------------------------------------
+# fused candidate selection (static circulant median / trimmed mean)
+# ---------------------------------------------------------------------------
+
+
+def _candidate_kernel(own_ref, b_ref, out_ref, *, offsets, trim, median):
+    o_blk = own_ref[:].astype(jnp.float32)
+    b_blk = b_ref[:].astype(jnp.float32)
+    cand = [o_blk] + [jnp.roll(b_blk, -off, axis=0) for off in offsets]
+    m = len(cand)
+    # Odd-even transposition network: m passes of compare-exchange sort the
+    # m-candidate stack coordinate-wise (exact — same sorted values as
+    # jnp.sort over the stacked axis).
+    for sweep in range(m):
+        for j in range(sweep % 2, m - 1, 2):
+            lo = jnp.minimum(cand[j], cand[j + 1])
+            hi = jnp.maximum(cand[j], cand[j + 1])
+            cand[j], cand[j + 1] = lo, hi
+    if median:
+        res = 0.5 * (cand[(m - 1) // 2] + cand[m // 2])
+    else:
+        kept = cand[trim : m - trim]
+        acc = kept[0]
+        for c in kept[1:]:
+            acc = acc + c
+        res = acc / float(len(kept))
+    out_ref[:] = res.astype(out_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("offsets", "trim", "median", "interpret")
+)
+def _candidate_call(own, bcast, offsets, trim, median, interpret):
+    n, p = bcast.shape
+    m = len(offsets) + 1
+    chunk = _chunk_cols(n, p, m + 2)
+    p_pad = -(-p // chunk) * chunk
+    own_p = _pad_cols(own, p_pad)
+    b_p = _pad_cols(bcast, p_pad)
+    out = pl.pallas_call(
+        functools.partial(
+            _candidate_kernel,
+            offsets=tuple(offsets),
+            trim=trim,
+            median=median,
+        ),
+        grid=(p_pad // chunk,),
+        in_specs=[
+            pl.BlockSpec((n, chunk), lambda i: (0, i)),
+            pl.BlockSpec((n, chunk), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((n, chunk), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((n, p_pad), own.dtype),
+        interpret=interpret,
+    )(own_p, b_p)
+    return out[:, :p]
+
+
+def candidate_select_supported(
+    own,
+    bcast,
+    offsets: Sequence[int],
+    trim: int = 0,
+    interpret: Optional[bool] = None,
+) -> bool:
+    """Static envelope predicate for :func:`fused_candidate_select` — lets
+    rules pick the kernel vs the lax path with a plain Python branch (no
+    traced operand, MUR001-clean) at trace time."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if not offsets or tuple(own.shape) != tuple(bcast.shape):
+        return False
+    m = len(offsets) + 1
+    if trim < 0 or m - 2 * trim < 1:
+        return False
+    if not interpret and bcast.shape[0] % 128 != 0:
+        return False  # in-kernel rolls wrap at the resident row count
+    return True
+
+
+def fused_candidate_select(
+    own: jnp.ndarray,
+    bcast: jnp.ndarray,
+    offsets: Sequence[int],
+    trim: int = 0,
+    median: bool = False,
+    interpret: Optional[bool] = None,
+) -> Optional[jnp.ndarray]:
+    """[N, P] coordinate-wise median (``median=True``) or ``trim``-trimmed
+    mean over the static circulant candidate stack {own} ∪ {k rolled
+    broadcasts}, fused with the streaming read.  ``None`` outside the
+    envelope (masked/sparse candidate sets keep the lax path — their
+    per-node counts are traced)."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if not candidate_select_supported(
+        own, bcast, offsets, trim=0 if median else trim, interpret=interpret
+    ):
+        return None
+    return _candidate_call(
+        own, bcast, tuple(int(o) for o in offsets), int(trim), bool(median),
+        interpret,
+    )

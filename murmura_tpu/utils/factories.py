@@ -241,6 +241,37 @@ def build_telemetry_writer(
     )
 
 
+def build_compression_spec(config: Config):
+    """Trace-time CompressionSpec from config.compression, or None when
+    off — the single construction path for every consumer (single runs and
+    gangs), so codec semantics cannot drift between them."""
+    c = config.compression
+    if c.algorithm == "none":
+        return None
+    from murmura_tpu.ops.compress import CompressionSpec
+
+    return CompressionSpec(
+        algorithm=c.algorithm,
+        block=c.block,
+        topk_ratio=c.topk_ratio,
+        error_feedback=c.error_feedback,
+    )
+
+
+def pallas_agg_enabled(config: Config, node_axis_sharded: bool) -> bool:
+    """Whether to route this build's aggregation through the fused Pallas
+    kernels (tpu.pallas_agg, env twin MURMURA_PALLAS_AGG=1).  Never on a
+    sharded node axis — pallas_call does not decompose under GSPMD, so the
+    sharded path keeps the lax kernels."""
+    import os
+
+    if node_axis_sharded:
+        return False
+    return bool(config.tpu.pallas_agg) or os.environ.get(
+        "MURMURA_PALLAS_AGG"
+    ) == "1"
+
+
 def build_fault_spec(config: Config):
     """Trace-time FaultSpec from config.faults, or None when off."""
     f = config.faults
@@ -520,6 +551,8 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None):
             model_dim = model_dimension(
                 jax.eval_shape(model.init, jax.random.PRNGKey(0))
             )
+            if pallas_agg_enabled(config, node_axis_sharded):
+                agg_params.setdefault("pallas", True)
             agg = build_aggregator(
                 config.aggregation.algorithm, agg_params,
                 model_dim=model_dim, total_rounds=rounds,
@@ -543,6 +576,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None):
             faults=build_fault_spec(config),
             audit_taps=config.telemetry.audit_taps,
             hp_inputs=hp_inputs,
+            compression=build_compression_spec(config),
         ))
 
     writers = None
@@ -704,6 +738,10 @@ def build_network_from_config(
     model_dim = model_dimension(
         jax.eval_shape(model.init, jax.random.PRNGKey(0))
     )
+    if pallas_agg_enabled(config, _node_axis_sharded(config, mesh)):
+        # Fused Pallas aggregation kernels (ops/pallas_agg.py); rules that
+        # have no kernel path ignore the param.
+        agg_params.setdefault("pallas", True)
     agg = build_aggregator(
         config.aggregation.algorithm, agg_params, model_dim=model_dim,
         total_rounds=rounds,
@@ -734,6 +772,7 @@ def build_network_from_config(
         faults=build_fault_spec(config),
         audit_taps=config.telemetry.audit_taps,
         sparse_offsets=tuple(topology.offsets) if sparse else None,
+        compression=build_compression_spec(config),
     )
 
     if config.backend == "tpu" and mesh is None:

@@ -13,12 +13,14 @@ CHAOS=0
 PROFILE=0
 GANG=0
 POPULATION=0
+COMPRESS=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
     --profile) PROFILE=1; shift;;
     --gang) GANG=1; shift;;
     --population) POPULATION=1; shift;;
+    --compress) COMPRESS=1; shift;;
     *) break;;
   esac
 done
@@ -271,6 +273,79 @@ PYEOF
     exit 1
   fi
   echo "preflight population clean" | tee -a "$OUT/battery.log"
+fi
+# Optional compressed-exchange pre-flight (./run_tpu_battery.sh --compress
+# [outdir]): the ISSUE-7 gates — an int8 + error-feedback krum smoke on
+# the attack scenario must (a) land honest accuracy within tolerance of
+# the uncompressed run, (b) finish with ZERO post-warmup recompiles
+# (CompileTracker via tpu.recompile_guard — scales/residuals are traced
+# values, never structure), and (c) show the >= 3x analytic exchange-bytes
+# reduction the bench variants report.  CPU-pinned like the other gates.
+if [ "$COMPRESS" = 1 ]; then
+  echo "=== preflight: compressed exchange (int8+EF krum) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 900 env JAX_PLATFORMS=cpu python - > "$OUT/preflight_compress.out" 2>&1 <<'PYEOF'
+import sys
+import numpy as np
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+
+def raw(**over):
+    r = {
+        "experiment": {"name": "compress-preflight", "seed": 11, "rounds": 6},
+        "topology": {"type": "k-regular", "num_nodes": 16, "k": 4},
+        "aggregation": {"algorithm": "krum",
+                        "params": {"num_compromised": 1}},
+        "attack": {"enabled": True, "type": "gaussian", "percentage": 0.2,
+                   "params": {"noise_std": 10.0}},
+        "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 16 * 32, "input_dim": 10,
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 10, "hidden_dims": [16],
+                             "num_classes": 3}},
+        "backend": "simulation",
+    }
+    r.update(over)
+    return r
+
+def honest_acc(net, hist):
+    comp = net.compromised > 0
+    return hist.get("honest_accuracy", hist["mean_accuracy"])[-1]
+
+base = build_network_from_config(Config.model_validate(raw()))
+h0 = base.train(rounds=6, eval_every=6)
+# tpu.recompile_guard raises RecompileError on ANY post-warmup compile —
+# the 6 rounds under the guard ARE the zero-recompile assertion.
+comp_net = build_network_from_config(Config.model_validate(raw(
+    compression={"algorithm": "int8", "error_feedback": True, "block": 256},
+    tpu={"recompile_guard": True},
+)))
+h1 = comp_net.train(rounds=6, eval_every=6)
+a0, a1 = honest_acc(base, h0), honest_acc(comp_net, h1)
+# One-sided: the codec must not LOSE accuracy (beating the uncompressed
+# run — quantization noise sometimes regularizes — is not a failure).
+if a1 < a0 - 0.02:
+    print(f"int8+EF honest accuracy {a1:.4f} more than 2% below "
+          f"uncompressed {a0:.4f}")
+    sys.exit(1)
+cost = comp_net.exchange_cost_analysis()
+if cost["exchange_bytes_reduction"] < 3.0:
+    print(f"analytic exchange-bytes reduction "
+          f"{cost['exchange_bytes_reduction']:.2f}x < 3x")
+    sys.exit(1)
+print(f"compressed exchange ok: honest acc {a1:.4f} vs {a0:.4f} "
+      f"(uncompressed), zero post-warmup recompiles, "
+      f"{cost['exchange_bytes_reduction']:.2f}x fewer exchange bytes "
+      f"({cost['payload_bytes_per_edge']:.0f} vs "
+      f"{cost['uncompressed_bytes_per_edge']:.0f} per edge)")
+PYEOF
+  then
+    echo "preflight compress FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_compress.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight compress clean" | tee -a "$OUT/battery.log"
 fi
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py

@@ -449,3 +449,74 @@ class TestJsonOutput:
             app, ["check", "--json", "--no-contracts", str(good)]
         )
         assert result.exit_code == 0
+
+
+class TestMUR700CompressedPayload:
+    """The MUR700 HLO scan (ir.float_exchange_operands): the compressed
+    payload — not a dequantized float tensor — is what crosses the
+    collectives.  The positive sweep itself runs in check_ir (tier-1 via
+    test_analysis_contracts); here the scan's negatives are pinned on
+    synthetic HLO so a regression in the regexes cannot go vacuous."""
+
+    def test_flags_full_width_float_collective(self):
+        from murmura_tpu.analysis.ir import float_exchange_operands
+
+        txt = (
+            "%collective-permute.1 = f32[3,256]{1,0} "
+            "collective-permute(f32[3,256]{1,0} %fusion.2), channel_id=1\n"
+        )
+        offending, lines = float_exchange_operands(txt, 256)
+        assert offending == ["f32[3,256]"]
+        assert len(lines) == 1
+
+    def test_int8_payload_and_scales_are_clean(self):
+        from murmura_tpu.analysis.ir import float_exchange_operands
+
+        txt = (
+            "%collective-permute = s8[3,256]{1,0} "
+            "collective-permute(s8[3,256]{1,0} %slice.1), channel_id=1\n"
+            "%collective-permute.1 = f32[3,4]{1,0} "
+            "collective-permute(f32[3,4]{1,0} %slice.2), channel_id=2\n"
+        )
+        offending, lines = float_exchange_operands(txt, 256)
+        assert offending == []
+        assert len(lines) == 2
+        assert any("s8[" in ln for ln in lines)
+
+    def test_fusion_lines_referencing_collectives_are_ignored(self):
+        # The bug the opcode-anchored regex exists for: a fusion CONSUMING
+        # %collective-permute.7 as an operand carries full-width float
+        # shapes but moves nothing.
+        from murmura_tpu.analysis.ir import float_exchange_operands
+
+        txt = (
+            "%collective-permute.7 = s8[1,256]{1,0} "
+            "collective-permute(s8[1,256]{1,0} %slice.1), channel_id=1\n"
+            "%broadcast_divide_fusion = f32[3,256]{1,0} fusion(f32[3,256]"
+            "{1,0} %param, f32[1,4]{1,0} %collective-permute.7)\n"
+        )
+        offending, _ = float_exchange_operands(txt, 256)
+        assert offending == []
+
+    def test_quantized_exchange_rules_declare_the_flag(self):
+        # The MUR700 sweep's rule set must match what the factories
+        # actually build: every QUANTIZED_EXCHANGE_RULES circulant build
+        # sets AggregatorDef.quantized_exchange, and the probe/sketch
+        # rules do not (they receive the dequantized tensor).
+        from murmura_tpu.aggregation import build_aggregator
+        from murmura_tpu.analysis.ir import QUANTIZED_EXCHANGE_RULES
+
+        for name in QUANTIZED_EXCHANGE_RULES:
+            agg = build_aggregator(
+                name, {"exchange_offsets": [1, 2]}, model_dim=64,
+                total_rounds=5,
+            )
+            assert agg.quantized_exchange, name
+            dense = build_aggregator(name, {}, model_dim=64, total_rounds=5)
+            assert not dense.quantized_exchange, f"{name} (dense)"
+        for name in ("ubar", "sketchguard", "evidential_trust"):
+            agg = build_aggregator(
+                name, {"exchange_offsets": [1, 2]}, model_dim=64,
+                total_rounds=5,
+            )
+            assert not agg.quantized_exchange, name

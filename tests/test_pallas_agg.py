@@ -1,0 +1,232 @@
+"""Fused Pallas aggregation kernels (ops/pallas_agg.py) vs the lax
+reference paths (interpret mode — the suite is pinned to CPU).
+
+Parity contract (docs/PERFORMANCE.md): the kernels accumulate chunk sums
+in f32 like the lax kernels but group them differently, so rule outputs
+agree to documented tolerance, not bit-exactly.  The candidate-select
+sorting network is exact (same sorted values as jnp.sort)."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from murmura_tpu.aggregation import build_aggregator
+from murmura_tpu.aggregation.base import (
+    AggContext,
+    circulant_neighbor_distances,
+    pairwise_l2_distances,
+)
+from murmura_tpu.ops import pallas_agg
+from murmura_tpu.ops.compress import quantize_int8
+
+
+RNG = np.random.default_rng(42)
+
+
+def _arrs(n, p, scale=0.5, dtype=np.float32):
+    a = jnp.asarray(RNG.normal(size=(n, p)).astype(dtype) * scale)
+    b = jnp.asarray(RNG.normal(size=(n, p)).astype(dtype) * scale)
+    return a, b
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("n,p", [(8, 256), (12, 300)])
+    def test_circulant_distances(self, n, p):
+        own, b = _arrs(n, p)
+        offsets = [1, 2, n - 1]
+        got = pallas_agg.circulant_sq_distances(own, b, offsets, interpret=True)
+        ref = circulant_neighbor_distances(own, b, offsets) ** 2
+        assert got is not None
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-4
+        )
+
+    def test_circulant_distances_multi_chunk(self, monkeypatch):
+        # Force the grid to several chunks: partial sums must agree.
+        monkeypatch.setattr(pallas_agg, "_VMEM_BLOCK_BYTES", 8 * 1024)
+        own, b = _arrs(8, 700)
+        offsets = [1, 3]
+        got = pallas_agg.circulant_sq_distances(own, b, offsets, interpret=True)
+        ref = circulant_neighbor_distances(own, b, offsets) ** 2
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-4
+        )
+
+    def test_pairwise_distances(self):
+        a, b = _arrs(10, 320)
+        got = pairwise_l2_distances(a, b, pallas=True)
+        ref = pairwise_l2_distances(a, b)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-2
+        )
+
+    def test_pairwise_same_tensor(self):
+        a, _ = _arrs(10, 320)
+        got = pairwise_l2_distances(a, pallas=True)
+        ref = pairwise_l2_distances(a)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-2
+        )
+
+    def test_pairwise_too_large_falls_back(self):
+        # Above the VMEM accumulator cap the kernel declines and the
+        # dispatcher must return the lax result, not crash.
+        n = 2048  # n*n > _MAX_PAIRWISE_CELLS
+        a = jnp.asarray(RNG.normal(size=(n, 4)).astype(np.float32))
+        assert pallas_agg.pairwise_sq_distances(a, a, interpret=True) is None
+        out = pairwise_l2_distances(a, pallas=True)
+        assert out.shape == (n, n)
+
+    @pytest.mark.parametrize("median,trim", [(True, 0), (False, 1)])
+    def test_candidate_select(self, median, trim):
+        own, b = _arrs(9, 260)
+        offsets = [1, 2, 4, 5]
+        m = len(offsets) + 1
+        got = pallas_agg.fused_candidate_select(
+            own, b, offsets, trim=trim, median=median, interpret=True
+        )
+        stack = jnp.stack([own] + [jnp.roll(b, -o, axis=0) for o in offsets])
+        ranked = jnp.sort(stack, axis=0)
+        if median:
+            ref = 0.5 * (ranked[(m - 1) // 2] + ranked[m // 2])
+        else:
+            ref = ranked[trim : m - trim].mean(axis=0)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-6, atol=1e-6
+        )
+
+    def test_candidate_select_rejects_over_trim(self):
+        own, b = _arrs(4, 64)
+        assert (
+            pallas_agg.fused_candidate_select(
+                own, b, [1, 2], trim=2, median=False, interpret=True
+            )
+            is None
+        )
+
+    def test_quantized_payload_skips_pallas(self):
+        # compression + pallas: the quantized dispatch wins; the pallas
+        # branch must not crash on the Int8Blocks payload.
+        own, b = _arrs(8, 256)
+        qb = quantize_int8(b, block=64)
+        got = circulant_neighbor_distances(own, qb, [1, 2], pallas=True)
+        ref = circulant_neighbor_distances(own, qb.dequantize(), [1, 2])
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-4
+        )
+
+
+def _cell(rule, params, n, dim, circulant, pallas):
+    case = dict(params, pallas=pallas)
+    if circulant:
+        case["exchange_offsets"] = [1, 2]
+    agg = build_aggregator(rule, case, model_dim=dim, total_rounds=10)
+    own = jnp.asarray(RNG.normal(size=(n, dim)).astype(np.float32) * 0.1)
+    bcast = jnp.asarray(RNG.normal(size=(n, dim)).astype(np.float32) * 0.1)
+    if circulant:
+        adj = np.zeros((n, n), np.float32)
+        for o in (1, 2):
+            adj[np.arange(n), (np.arange(n) + o) % n] = 1.0
+    else:
+        adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+    state = {k: jnp.asarray(v) for k, v in agg.init_state(n).items()}
+    ctx = AggContext(total_rounds=10, num_classes=4)
+    if agg.needs_probe:
+        from jax.flatten_util import ravel_pytree
+
+        from murmura_tpu.models import make_mlp
+
+        model = make_mlp(input_dim=4, hidden_dims=(8,), num_classes=4)
+        flat0, unravel = ravel_pytree(model.init(jax.random.PRNGKey(0)))
+        dim = flat0.size
+        own = jnp.asarray(RNG.normal(size=(n, dim)).astype(np.float32) * 0.1)
+        bcast = jnp.asarray(RNG.normal(size=(n, dim)).astype(np.float32) * 0.1)
+        ctx = dataclasses.replace(
+            ctx,
+            apply_fn=model.apply,
+            unravel=unravel,
+            probe_x=jnp.asarray(RNG.normal(size=(n, 8, 4)), jnp.float32),
+            probe_y=jnp.asarray(RNG.integers(0, 4, size=(n, 8)), jnp.int32),
+            probe_mask=jnp.ones((n, 8), jnp.float32),
+        )
+    return agg.aggregate(
+        own, bcast, jnp.asarray(adj), jnp.asarray(0.0, jnp.float32), state,
+        ctx,
+    )
+
+
+class TestRuleParity:
+    """The acceptance surface: krum / ubar / trimmed_mean (and median)
+    produce the same aggregation with the kernels armed, dense and
+    circulant, to documented tolerance."""
+
+    # Deterministic per-cell RNG: _cell consumes the module RNG, so build
+    # both variants from one cell call pair with a reseed.
+    @pytest.mark.parametrize(
+        "rule,params",
+        [
+            ("krum", {"num_compromised": 1}),
+            ("ubar", {}),
+            ("trimmed_mean", {}),
+            ("median", {}),
+        ],
+    )
+    @pytest.mark.parametrize("circulant", [False, True])
+    def test_rule_outputs_match(self, rule, params, circulant):
+        global RNG
+        RNG = np.random.default_rng(7)
+        ref_flat, _, ref_stats = _cell(rule, params, 8, 256, circulant, False)
+        RNG = np.random.default_rng(7)
+        got_flat, _, got_stats = _cell(rule, params, 8, 256, circulant, True)
+        np.testing.assert_allclose(
+            np.asarray(got_flat), np.asarray(ref_flat), rtol=1e-4, atol=1e-4
+        )
+        for k in ref_stats:
+            np.testing.assert_allclose(
+                np.asarray(got_stats[k]), np.asarray(ref_stats[k]),
+                rtol=1e-4, atol=1e-4,
+                err_msg=f"{rule} stat {k} diverged under pallas",
+            )
+
+    def test_selection_identical_on_separated_clusters(self):
+        """Krum's *selection* (not just scores) is identical when the
+        distance structure is non-degenerate — the tolerance in the
+        distances must not flip winners on real Byzantine geometry."""
+        global RNG
+        RNG = np.random.default_rng(11)
+        n, dim = 8, 256
+        base = RNG.normal(size=(1, dim)).astype(np.float32) * 0.1
+        honest = base + RNG.normal(size=(n, dim)).astype(np.float32) * 0.01
+        honest[0] += 5.0  # one far outlier
+        own = jnp.asarray(honest)
+        for circulant in (False, True):
+            case = {"num_compromised": 1}
+            if circulant:
+                case["exchange_offsets"] = [1, 2]
+            ref = build_aggregator(
+                "krum", dict(case), model_dim=dim, total_rounds=10
+            )
+            got = build_aggregator(
+                "krum", dict(case, pallas=True), model_dim=dim,
+                total_rounds=10,
+            )
+            if circulant:
+                adj = np.zeros((n, n), np.float32)
+                for o in (1, 2):
+                    adj[np.arange(n), (np.arange(n) + o) % n] = 1.0
+            else:
+                adj = (np.ones((n, n)) - np.eye(n)).astype(np.float32)
+            ctx = AggContext(total_rounds=10, num_classes=4)
+            args = (
+                own, own, jnp.asarray(adj), jnp.asarray(0.0, jnp.float32),
+                {}, ctx,
+            )
+            _, _, s_ref = ref.aggregate(*args)
+            _, _, s_got = got.aggregate(*args)
+            assert np.array_equal(
+                np.asarray(s_ref["selected_index"]),
+                np.asarray(s_got["selected_index"]),
+            )

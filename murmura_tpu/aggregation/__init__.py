@@ -6,6 +6,7 @@ from typing import Any, Dict
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     masked_neighbor_mean,
     pairwise_l2_distances,
 )
@@ -65,6 +66,7 @@ def build_aggregator(
 __all__ = [
     "AggContext",
     "AggregatorDef",
+    "InfluenceDecl",
     "AGGREGATORS",
     "build_aggregator",
     "make_fedavg",

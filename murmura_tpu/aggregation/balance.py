@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     blend_with_own,
     circulant_in_degree,
     circulant_masked_mean,
@@ -168,4 +169,13 @@ def make_balance(
         # only through the shared roll kernels, which move the int8
         # payload (MUR700).
         quantized_exchange=offsets is not None,
+        # MUR800: the distance filter is data-dependent — on benign inputs
+        # every neighbor passes the threshold and the accepted mean spans
+        # the whole neighborhood.  The cap exists only under attack, which
+        # a static cardinality bound cannot promise; declared unbounded.
+        influence=InfluenceDecl(
+            "unbounded",
+            note="distance-threshold accept-filter: benign inputs accept "
+            "every neighbor; exclusion is data-dependent, not structural",
+        ),
     )

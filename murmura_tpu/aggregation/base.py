@@ -98,6 +98,68 @@ class AggContext:
 
 
 @dataclass(frozen=True)
+class InfluenceDecl:
+    """Declared Byzantine influence contract of a rule (``murmura check
+    --flow``, MUR800-802 — analysis/flow.py).
+
+    The flow analyzer seeds each exchanged broadcast row with a distinct
+    taint label and propagates *value* dataflow through the rule's jaxpr
+    (selection dataflow — comparisons, sort permutations, gather indices,
+    ``where`` predicates — is excluded by construction: it decides WHICH
+    finite values are chosen, and the finiteness precondition is
+    discharged separately by the MUR803 scrub-dominance check).  The
+    resulting per-output-coordinate taint cardinality is the number of
+    distinct neighbors whose broadcast VALUES can enter that coordinate.
+
+    ``kind="bounded"`` declares a cap: ``bound(k)`` maps the per-node
+    neighbor count ``k`` (non-self candidates; self is always excluded
+    from the cardinality) to the maximum labels any single output
+    coordinate may carry — e.g. Krum's single winner (1), the
+    coordinate-wise median's middle pair, the trimmed mean's kept
+    interior.  MUR800 fails when the analyzed cardinality exceeds it.
+
+    ``kind="unbounded"`` is an explicit admission that every neighbor's
+    value can reach the output (fedavg's mean) or that the cap is
+    data-dependent and vanishes on benign inputs (BALANCE/UBAR-style
+    accept-filters admit everything when nothing looks hostile; the
+    geometric median downweights but never excludes).  ``note`` says why
+    — it doubles as runtime documentation (``murmura report`` prints it
+    next to the observed audit-tap rejection counts).
+
+    Declaring nothing is itself a finding (MUR801): every registered rule
+    must state its influence contract, exactly as it must state its
+    collective inventory.
+    """
+
+    kind: str  # "bounded" | "unbounded"
+    bound: Optional[Callable[[int], int]] = None
+    note: str = ""
+
+    def __post_init__(self):
+        if self.kind not in ("bounded", "unbounded"):
+            raise ValueError(
+                f"influence kind must be 'bounded' or 'unbounded', got "
+                f"{self.kind!r}"
+            )
+        if self.kind == "bounded" and self.bound is None:
+            raise ValueError("bounded influence declarations need a bound()")
+        if self.kind == "unbounded" and self.bound is not None:
+            raise ValueError(
+                "unbounded influence declarations must not carry a bound()"
+            )
+
+    def describe(self, k: Optional[int] = None) -> str:
+        """Human-readable contract line (the `murmura report` rendering)."""
+        if self.kind == "unbounded":
+            base = "unbounded"
+        elif k is None:
+            base = "bounded"
+        else:
+            base = f"bounded: <= {self.bound(k)} of {k} neighbors per coordinate"
+        return f"{base} — {self.note}" if self.note else base
+
+
+@dataclass(frozen=True)
 class AggregatorDef:
     """A named aggregation rule with optional carried state.
 
@@ -135,6 +197,14 @@ class AggregatorDef:
     # the broadcast (probe forwards, sketch tables) keep False and receive
     # the receiver-side dequantized tensor from core/rounds.py.
     quantized_exchange: bool = False
+    # Declared Byzantine influence contract (see :class:`InfluenceDecl`):
+    # how many distinct neighbors' broadcast VALUES may enter any single
+    # output coordinate.  ``murmura check --flow`` verifies the analyzed
+    # taint cardinality against it per exchange mode (MUR800), requires
+    # every registered rule to declare one (MUR801), and pins the analyzed
+    # result's parity across dense/circulant/sparse/compressed modes
+    # (MUR802).  None = undeclared, itself a finding for registered rules.
+    influence: Optional[InfluenceDecl] = None
 
     def declared_collectives(self, circulant) -> Optional[FrozenSet[str]]:
         """Allowed collective set for one exchange mode (``None`` =

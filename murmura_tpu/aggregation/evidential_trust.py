@@ -40,6 +40,7 @@ import numpy as np
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     circulant_in_degree,
     circulant_weighted_sum,
     masked_neighbor_mean,
@@ -343,4 +344,14 @@ def make_evidential_trust(
             "dense": {"all_gather", "all_reduce", "all_to_all"},
             "circulant": {"all_gather", "all_reduce", "ppermute"},
         },
+        # MUR800: the trust-weighted blend normalizes over every accepted
+        # neighbor (and the trust normalizer couples them), so all
+        # neighbors' values reach the output when all are trusted — the
+        # benign case.  Exclusion (trust < threshold, the strength guard)
+        # is data-dependent; declared unbounded.
+        influence=InfluenceDecl(
+            "unbounded",
+            note="trust-normalized mean over accepted neighbors: benign "
+            "inputs trust everyone; exclusion is data-dependent",
+        ),
     )

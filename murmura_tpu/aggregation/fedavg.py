@@ -18,6 +18,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     circulant_weighted_sum,
 )
 
@@ -85,4 +86,13 @@ def make_fedavg(
         # only through the shared roll kernels, which move the int8
         # payload (MUR700).
         quantized_exchange=offsets is not None,
+        # MUR800: plain averaging has no Byzantine filter at all — every
+        # neighbor's state enters the 1/(1+degree) mean.  Declared
+        # unbounded on purpose: the flow analyzer must never be able to
+        # "prove" fedavg robust.
+        influence=InfluenceDecl(
+            "unbounded",
+            note="every neighbor's state enters the degree-normalized "
+            "mean; a single Byzantine row moves it arbitrarily",
+        ),
     )

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     candidate_indices,
     circulant_in_degree,
     circulant_masked_mean,
@@ -247,4 +248,15 @@ def make_krum(
         # only through the shared roll kernels, which move the int8
         # payload (MUR700).
         quantized_exchange=offsets is not None,
+        # MUR800: the output row is the single Krum winner (argmin score,
+        # gathered / one-hot-mean-selected) or the node's own state — at
+        # most ONE neighbor's values ever enter a node's parameters,
+        # regardless of how the scores were computed (score dataflow is
+        # selection influence, excluded by the analyzer's semantics).
+        influence=InfluenceDecl(
+            "bounded",
+            bound=lambda k: 1,
+            note="single Krum winner: at most one neighbor's state is "
+            "ever adopted; scores only decide which",
+        ),
     )

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     candidate_chunk_dispatch,
     candidate_indices,
     circulant_candidate_map,
@@ -183,6 +184,16 @@ def make_coordinate_median(
         # Compressed exchange: the circulant candidate stacks read the
         # broadcast only through the shared roll kernels (MUR700).
         quantized_exchange=offsets is not None,
+        # MUR800: each output coordinate is the middle element (odd
+        # candidate count) or the mean of the middle pair (even) of the
+        # sorted {self} ∪ neighbors stack — at most 1-2 neighbor values
+        # per coordinate; which ones is selection influence.
+        influence=InfluenceDecl(
+            "bounded",
+            bound=lambda k: 1 if (k + 1) % 2 else 2,
+            note="coordinate-wise median: the middle element (or pair) of "
+            "the sorted candidate stack",
+        ),
     )
 
 
@@ -319,6 +330,16 @@ def make_trimmed_mean(
         # Compressed exchange: the circulant candidate stacks read the
         # broadcast only through the shared roll kernels (MUR700).
         quantized_exchange=offsets is not None,
+        # MUR800: the tails are dropped, so each coordinate averages at
+        # most m - 2*floor(beta*m) of the m = k+1 sorted candidates (one
+        # of which may be the node's own state — the bound stays the
+        # conservative interior size).
+        influence=InfluenceDecl(
+            "bounded",
+            bound=lambda k: (k + 1) - 2 * int(beta * (k + 1)),
+            note=f"beta-trimmed mean (beta={beta}): only the sorted "
+            "interior is averaged; the trimmed tails never contribute",
+        ),
     )
 
 
@@ -539,4 +560,15 @@ def make_geometric_median(
         # Compressed exchange: the circulant candidate stacks read the
         # broadcast only through the shared roll kernels (MUR700).
         quantized_exchange=offsets is not None,
+        # MUR800: Weiszfeld reweights but never excludes — every candidate
+        # keeps a strictly positive 1/max(d, nu) weight, so every
+        # neighbor's values enter the iterate.  The robustness claim is
+        # norm-bounded drag (1/2 breakdown point), not cardinality-bounded
+        # influence, which the taint domain cannot express — declared
+        # unbounded with that note.
+        influence=InfluenceDecl(
+            "unbounded",
+            note="Weiszfeld weights are positive for every candidate; "
+            "robustness is norm-bounded drag, not exclusion",
+        ),
     )

@@ -19,6 +19,7 @@ from murmura_tpu.aggregation.balance import accept_with_closest_fallback
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     blend_with_own,
     circulant_masked_mean,
     circulant_neighbor_distances,
@@ -174,4 +175,12 @@ def make_sketchguard(
             "circulant": {"all_gather", "all_reduce", "ppermute"},
             "sparse": {"ppermute"},
         },
+        # MUR800: BALANCE-style distance filtering in sketch space — the
+        # accept set is data-dependent and spans the whole neighborhood on
+        # benign inputs; declared unbounded (the BALANCE rationale).
+        influence=InfluenceDecl(
+            "unbounded",
+            note="sketch-space distance accept-filter: benign inputs "
+            "accept every neighbor; exclusion is data-dependent",
+        ),
     )

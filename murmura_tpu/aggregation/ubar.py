@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from murmura_tpu.aggregation.base import (
     AggContext,
     AggregatorDef,
+    InfluenceDecl,
     blend_with_own,
     circulant_in_degree,
     circulant_masked_mean,
@@ -188,4 +189,16 @@ def make_ubar(
             "dense": {"all_gather", "all_reduce", "all_to_all"},
             "circulant": {"ppermute"},
         },
+        # MUR800: stage 1 is a STRUCTURAL cap — rank_mask keeps exactly
+        # max(min_neighbors, floor(rho*degree)) closest neighbors, and
+        # stage 2 (loss probe + best-loss fallback) only ever shrinks that
+        # shortlist.  No output coordinate can mix values from more
+        # neighbors than the stage-1 shortlist size.
+        influence=InfluenceDecl(
+            "bounded",
+            bound=lambda k: max(min_neighbors, int(rho * k)),
+            note=f"stage-1 distance shortlist caps accepted neighbors at "
+            f"max({min_neighbors}, floor({rho}*degree)); stage 2 only "
+            "shrinks it",
+        ),
     )

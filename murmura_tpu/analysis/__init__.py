@@ -39,28 +39,33 @@ def run_check_detailed(
     contracts: bool = True,
     ir: Optional[bool] = None,
     budget_path=None,
+    flow: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
-    """Run the full static pass and return ``(findings, budget_deltas)``.
+    """Run the full static pass and return ``(findings, records)``.
 
     The pass layers: AST lint over ``paths`` (default: the installed
-    murmura_tpu package), the cross-layer contract checks, and — when
-    ``ir`` is enabled — the jaxpr/HLO IR contracts (analysis/ir.py,
-    MUR200-205) plus the AOT cost-budget sweep (analysis/budgets.py,
-    MUR206).  ``ir=None`` means "on for the package check, off for
-    explicit paths" (the IR pass is package-global: it traces the live
-    registry, not the files named on the command line).
+    murmura_tpu package), the cross-layer contract checks, when ``ir`` is
+    enabled the jaxpr/HLO IR contracts (analysis/ir.py, MUR200-205) plus
+    the AOT cost-budget sweep (analysis/budgets.py, MUR206), and when
+    ``flow`` is enabled the jaxpr dataflow contracts (analysis/flow.py,
+    MUR800-804).  ``ir=None``/``flow=None`` mean "on for the package
+    check, off for explicit paths" (both passes are package-global: they
+    trace the live registry, not the files named on the command line).
 
-    ``budget_deltas`` carries one record per budget grid cell (measured vs
-    committed flops/bytes, including in-tolerance cells) and is empty when
-    the IR pass does not run.
+    ``records`` carries machine-readable non-finding rows for
+    ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
+    grid cell (measured vs committed flops/bytes, including in-tolerance
+    cells) and one ``{"kind": "flow_summary", ...}`` per (rule, exchange
+    mode) flow cell with its per-node taint-set payload.
     """
     run_ir = ir if ir is not None else not paths
+    run_flow = flow if flow is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
     if contracts:
         findings.extend(check_contracts())
-    deltas: List[Dict[str, Any]] = []
+    records: List[Dict[str, Any]] = []
     if run_ir:
         from murmura_tpu.analysis import budgets as budgets_mod
         from murmura_tpu.analysis import ir as ir_mod
@@ -68,18 +73,25 @@ def run_check_detailed(
         findings.extend(ir_mod.check_ir())
         budget_findings, deltas = budgets_mod.check_budgets(budget_path)
         findings.extend(budget_findings)
+        records.extend({"kind": "budget_delta", **d} for d in deltas)
+    if run_flow:
+        from murmura_tpu.analysis import flow as flow_mod
+
+        findings.extend(flow_mod.check_flow())
+        records.extend(flow_mod.flow_summaries())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
-    return findings, deltas
+    return findings, records
 
 
 def run_check(
     paths: Optional[Sequence] = None,
     contracts: bool = True,
     ir: Optional[bool] = None,
+    flow: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
-    return run_check_detailed(paths, contracts=contracts, ir=ir)[0]
+    return run_check_detailed(paths, contracts=contracts, ir=ir, flow=flow)[0]
 
 
 def format_findings(findings: Iterable[Finding]) -> str:
@@ -91,11 +103,15 @@ def format_findings(findings: Iterable[Finding]) -> str:
 
 def format_findings_json(
     findings: Iterable[Finding],
-    budget_deltas: Optional[Iterable[Dict[str, Any]]] = None,
+    records: Optional[Iterable[Dict[str, Any]]] = None,
 ) -> str:
     """JSON-lines rendering for editors/CI (``check --json``): one
-    ``{"kind": "finding", ...}`` object per finding followed by one
-    ``{"kind": "budget_delta", ...}`` object per budget grid cell."""
+    ``{"kind": "finding", ...}`` object per finding followed by the
+    non-finding records — ``budget_delta`` rows per cost grid cell and
+    ``flow_summary`` rows per (rule, exchange mode) flow cell (their
+    per-rule taint-set payloads ride ``data``/``taint_sets``).  Legacy
+    callers may still pass bare budget-delta dicts; they default to
+    ``kind: budget_delta``."""
     lines = [
         json.dumps(
             {
@@ -110,7 +126,7 @@ def format_findings_json(
         )
         for f in findings
     ]
-    for rec in budget_deltas or ():
+    for rec in records or ():
         lines.append(json.dumps({"kind": "budget_delta", **rec}))
     return "\n".join(lines)
 

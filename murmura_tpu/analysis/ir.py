@@ -111,6 +111,30 @@ _COLL_RE = re.compile(
 _ALIAS_RE = re.compile(r"\b(?:may|must)-alias\b")
 
 
+# Registry of round-program-level check families ``check_ir`` runs after
+# the per-rule canonical sweep: name -> (callable, crash rule id, crash
+# anchor file relative to the package).  Populated by the ``@_ir_family``
+# decorator on each ``check_*`` function below; ``check_coverage`` scans
+# this module (and analysis/flow.py's twin registry) for any module-level
+# ``check_*`` function that is NOT registered — a new MUR family someone
+# wrote but never wired into ``check_ir``/tier-1 becomes a finding, not a
+# silent gap.
+IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
+
+# Entry points / meta-checks that are wired elsewhere by design: check_ir
+# IS the runner, check_coverage runs first inside it, and analysis/flow's
+# check_flow is its own runner composed by run_check_detailed.
+_CHECK_ENTRY_POINTS = frozenset({"check_ir", "check_coverage", "check_flow"})
+
+
+def _ir_family(crash_rule: str, crash_anchor: str):
+    def deco(fn):
+        IR_CHECK_FAMILIES[fn.__name__] = (fn, crash_rule, crash_anchor)
+        return fn
+
+    return deco
+
+
 def _ensure_host_devices(count: int = 8) -> None:
     """Request a multi-device host platform for the MUR202 sharded
     lowerings, when the XLA backend is not initialized yet (the CLI path;
@@ -569,6 +593,7 @@ def _check_collectives(name: str, prog: CanonicalProgram) -> List[Finding]:
     )]
 
 
+@_ir_family("MUR204", "core/rounds.py")
 def check_donation() -> List[Finding]:
     """MUR204: the round step's donated buffers are actually aliased.
 
@@ -641,6 +666,7 @@ def check_donation() -> List[Finding]:
     return findings
 
 
+@_ir_family("MUR302", "core/rounds.py")
 def check_fault_round() -> List[Finding]:
     """MUR302/MUR303: the fault model is IR-inert.
 
@@ -773,6 +799,7 @@ def check_fault_round() -> List[Finding]:
     return findings
 
 
+@_ir_family("MUR500", "core/gang.py")
 def check_gang_round() -> List[Finding]:
     """MUR500/MUR501: gang batching (core/gang.py) is IR-inert.
 
@@ -966,6 +993,7 @@ SPARSE_DENSE_FREE: Tuple[str, ...] = (
 SPARSE_INVENTORY_RULES: Tuple[str, ...] = ("fedavg", "krum", "ubar", "median")
 
 
+@_ir_family("MUR600", "core/rounds.py")
 def check_sparse_exchange() -> List[Finding]:
     """MUR600/MUR601: the sparse exchange engine is dense-free and
     communication-clean (docs/SCALING.md).
@@ -1188,6 +1216,7 @@ def float_exchange_operands(hlo_text: str, width: int):
     return offending, coll_lines
 
 
+@_ir_family("MUR700", "core/rounds.py")
 def check_compressed_exchange() -> List[Finding]:
     """MUR700/701/702: the compressed exchange moves compressed bytes and
     is IR-inert (docs/PERFORMANCE.md; ops/compress.py).
@@ -1411,6 +1440,7 @@ def check_compressed_exchange() -> List[Finding]:
 TAPPED_RULES: Tuple[str, ...] = ("krum", "balance", "ubar", "evidential_trust")
 
 
+@_ir_family("MUR400", "core/rounds.py")
 def check_telemetry_taps() -> List[Finding]:
     """MUR400/MUR402: the audit taps are IR-inert (docs/OBSERVABILITY.md).
 
@@ -1553,9 +1583,39 @@ def check_telemetry_taps() -> List[Finding]:
     return findings
 
 
+def _unwired_family_findings(module, registry: Dict[str, Any]) -> List[Finding]:
+    """Module-level ``check_*`` callables that are neither in the module's
+    check-family registry nor a known entry point — a new MUR family that
+    would otherwise silently never run in ``check``/tier-1."""
+    findings: List[Finding] = []
+    mod_path = str(Path(module.__file__).resolve())
+    for attr, obj in sorted(vars(module).items()):
+        if not attr.startswith("check_") or not callable(obj):
+            continue
+        if attr in registry or attr in _CHECK_ENTRY_POINTS:
+            continue
+        findings.append(Finding(
+            "MUR205", mod_path, 1,
+            f"{module.__name__.rsplit('.', 1)[-1]}.{attr} is a check "
+            "family that is not registered in its module's check-family "
+            "registry — it will never run in `check`/tier-1; register it "
+            "(@_ir_family in analysis/ir.py, @_family in analysis/flow.py) "
+            "or rename it",
+        ))
+    return findings
+
+
 def check_coverage() -> List[Finding]:
     """MUR205: registry <-> canonical-case bijection (the MUR101
-    counterpart that keeps every other MUR2xx rule non-vacuous)."""
+    counterpart that keeps every other MUR2xx rule non-vacuous), plus the
+    check-family wiring audit: every module-level ``check_*`` function in
+    analysis/ir.py and analysis/flow.py must be enumerated by its module's
+    check-family registry (IR_CHECK_FAMILIES / FLOW_CHECK_FAMILIES) —
+    enumeration comes from the registry, never a hand-maintained call
+    list, so a future MUR family that is written but not wired into
+    ``check_ir``/``check_flow`` is a finding, not a silent gap."""
+    import sys
+
     from murmura_tpu.aggregation import AGGREGATORS
 
     pkg = Path(__file__).resolve().parent.parent
@@ -1575,6 +1635,14 @@ def check_coverage() -> List[Finding]:
             f"AGG_CASES entry '{name}' names no registered aggregation "
             "rule — remove the stale canonical case",
         ))
+    from murmura_tpu.analysis import flow as flow_mod
+
+    findings.extend(
+        _unwired_family_findings(sys.modules[__name__], IR_CHECK_FAMILIES)
+    )
+    findings.extend(
+        _unwired_family_findings(flow_mod, flow_mod.FLOW_CHECK_FAMILIES)
+    )
     return findings
 
 
@@ -1646,60 +1714,19 @@ def check_ir(force: bool = False) -> List[Finding]:
                     f"aggregator '{name}' ({_mode(circulant)}) crashed the "
                     f"canonical IR sweep: {type(e).__name__}: {e}",
                 ))
-    try:
-        findings.extend(check_donation())
-    except Exception as e:  # noqa: BLE001 — a crash IS the finding
-        pkg = Path(__file__).resolve().parent.parent
-        findings.append(Finding(
-            "MUR204", str(pkg / "core" / "rounds.py"), 1,
-            f"the donation audit crashed compiling the canonical round "
-            f"programs: {type(e).__name__}: {e}",
-        ))
-    try:
-        findings.extend(check_fault_round())
-    except Exception as e:  # noqa: BLE001 — a crash IS the finding
-        pkg = Path(__file__).resolve().parent.parent
-        findings.append(Finding(
-            "MUR302", str(pkg / "core" / "rounds.py"), 1,
-            f"the fault-model IR contracts crashed: "
-            f"{type(e).__name__}: {e}",
-        ))
-    try:
-        findings.extend(check_telemetry_taps())
-    except Exception as e:  # noqa: BLE001 — a crash IS the finding
-        pkg = Path(__file__).resolve().parent.parent
-        findings.append(Finding(
-            "MUR400", str(pkg / "core" / "rounds.py"), 1,
-            f"the telemetry-tap IR contracts crashed: "
-            f"{type(e).__name__}: {e}",
-        ))
-    try:
-        findings.extend(check_gang_round())
-    except Exception as e:  # noqa: BLE001 — a crash IS the finding
-        pkg = Path(__file__).resolve().parent.parent
-        findings.append(Finding(
-            "MUR500", str(pkg / "core" / "gang.py"), 1,
-            f"the gang-batching IR contracts crashed: "
-            f"{type(e).__name__}: {e}",
-        ))
-    try:
-        findings.extend(check_sparse_exchange())
-    except Exception as e:  # noqa: BLE001 — a crash IS the finding
-        pkg = Path(__file__).resolve().parent.parent
-        findings.append(Finding(
-            "MUR600", str(pkg / "core" / "rounds.py"), 1,
-            f"the sparse-exchange IR contracts crashed: "
-            f"{type(e).__name__}: {e}",
-        ))
-    try:
-        findings.extend(check_compressed_exchange())
-    except Exception as e:  # noqa: BLE001 — a crash IS the finding
-        pkg = Path(__file__).resolve().parent.parent
-        findings.append(Finding(
-            "MUR700", str(pkg / "core" / "rounds.py"), 1,
-            f"the compressed-exchange IR contracts crashed: "
-            f"{type(e).__name__}: {e}",
-        ))
+    # Round-program-level families run off the registry — adding a family
+    # is one decorator, and an unregistered ``check_*`` function is itself
+    # a MUR205 finding (check_coverage's unwired-family scan).
+    pkg = Path(__file__).resolve().parent.parent
+    for fam_name, (fam, crash_rule, crash_anchor) in IR_CHECK_FAMILIES.items():
+        try:
+            findings.extend(fam())
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(Finding(
+                crash_rule, str(pkg / crash_anchor), 1,
+                f"the '{fam_name}' IR contracts crashed: "
+                f"{type(e).__name__}: {e}",
+            ))
 
     findings = _apply_suppressions(list(dict.fromkeys(findings)))
     findings.sort(key=lambda f: (f.path, f.line, f.rule))

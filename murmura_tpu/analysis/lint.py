@@ -90,6 +90,23 @@ RULES: Dict[str, str] = {
     # docs/PERFORMANCE.md)
     "MUR500": "gang-collective-inventory",
     "MUR501": "gang-bucket-recompile",
+    # 6xx = sparse exchange / population contracts (analysis/ir.py +
+    # analysis/contracts.py; docs/SCALING.md)
+    "MUR600": "sparse-dense-free",
+    "MUR601": "sparse-collective-inventory",
+    "MUR602": "sparse-population-bijections",
+    # 7xx = compressed exchange contracts (analysis/ir.py;
+    # docs/PERFORMANCE.md)
+    "MUR700": "compressed-payload",
+    "MUR701": "compression-recompile",
+    "MUR702": "compression-donation",
+    # 8xx = jaxpr dataflow contracts (analysis/flow.py, `check --flow`;
+    # docs/ANALYSIS.md)
+    "MUR800": "influence-bound",
+    "MUR801": "influence-declaration",
+    "MUR802": "influence-mode-parity",
+    "MUR803": "flow-scrub-dominance",
+    "MUR804": "flow-zero-denominator",
 }
 
 
@@ -119,6 +136,15 @@ STATIC_ATTRS = {
     # tap branches are ordinary staging-time control flow (MUR400/402 pin
     # that the tapped program is collective- and recompile-clean).
     "audit",
+    # CompressionSpec fields that traced code BRANCHES on (ops/compress.py,
+    # core/rounds.py): the codec choice and error-feedback toggle are
+    # trace-time program structure by contract (MUR701).  Deliberately
+    # minimal — the whitelist is name-based with no receiver-type
+    # awareness, so every name here weakens MUR001 for that attribute
+    # package-wide; Int8Blocks' shape-derived fields (block/p/num_blocks
+    # etc.) only appear in arithmetic/slicing, which the taint pass never
+    # flags, and stay OFF the list.
+    "algorithm", "error_feedback",
 }
 
 # Callables whose function-position arguments execute under a trace, mapped
@@ -145,6 +171,13 @@ TRACING_CALLS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
     "lax.switch": ((1,), ("branches",)),
     "jax.checkpoint": _FUN0, "jax.remat": _FUN0, "jax.eval_shape": _FUN0,
     "jax.lax.associative_scan": _FUN0, "lax.associative_scan": _FUN0,
+    # Pallas kernels execute under a trace too (ops/pallas_agg.py,
+    # ops/pallas_sketch.py): the kernel function handed to pallas_call —
+    # or closed over via functools.partial in argument position — is a
+    # traced scope, which is what pulls murmura_tpu/ops/ into the MUR0xx
+    # scan.
+    "pl.pallas_call": _FUN0, "pallas_call": _FUN0,
+    "jax.experimental.pallas.pallas_call": _FUN0,
 }
 
 # Function names the repo's protocols guarantee are traced: AggregatorDef
@@ -203,6 +236,11 @@ class _ModuleScanner:
         self.findings: List[Finding] = []
         self.traced_names: Set[str] = set(PROTOCOL_TRACED_NAMES)
         self.traced_lambdas: List[ast.Lambda] = []
+        # Keyword names bound by functools.partial when a kernel/function
+        # was handed to a tracing call (pl.pallas_call(partial(k, off=...)))
+        # — those parameters hold trace-setup-time Python values, never
+        # tracers, so they must not seed the taint set.
+        self.partial_static: Dict[str, Set[str]] = {}
 
     def emit(self, rule: str, node: ast.AST, message: str) -> None:
         line = getattr(node, "lineno", 1)
@@ -237,6 +275,23 @@ class _ModuleScanner:
                 # lax.switch takes a list/tuple of branch functions.
                 elts = arg.elts if isinstance(arg, (ast.Tuple, ast.List)) else [arg]
                 for el in elts:
+                    # functools.partial(kernel, ...) in function position
+                    # (the pallas_call idiom) traces the partial's target.
+                    if (
+                        isinstance(el, ast.Call)
+                        and _dotted(el.func) in {"functools.partial", "partial"}
+                        and el.args
+                    ):
+                        target = el.args[0]
+                        if isinstance(target, ast.Name):
+                            bound = {
+                                kw.arg for kw in el.keywords
+                                if kw.arg is not None
+                            }
+                            self.partial_static.setdefault(
+                                target.id, set()
+                            ).update(bound)
+                        el = target
                     if isinstance(el, ast.Name):
                         self.traced_names.add(el.id)
                     elif isinstance(el, ast.Lambda):
@@ -356,8 +411,13 @@ class _TaintScanner:
             self.tainted.add(a.vararg.arg)
         # **kwargs holds static configuration by convention — not tainted.
         # Params declared static in the jit decorator are Python values
-        # under the trace — branching on them is legal specialization.
+        # under the trace — branching on them is legal specialization, as
+        # are keywords bound by a functools.partial at the tracing call
+        # site (the pallas kernel-config idiom).
         self.tainted -= _static_params(fn)
+        self.tainted -= module.partial_static.get(
+            getattr(fn, "name", ""), set()
+        )
 
     def run(self) -> None:
         self._visit_body(self.fn.body)

@@ -359,23 +359,33 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "files).",
 )
 @click.option(
+    "--flow/--no-flow", "flow", default=None,
+    help="Run the jaxpr dataflow contracts (MUR800-804: per-neighbor "
+         "influence bounds, scrub dominance, zero-free denominators).  "
+         "Default: on for the package check, off when explicit PATHS are "
+         "given (the flow pass traces the live registry, not files).",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
-    help="Emit findings (and budget deltas) as JSON lines for editor/CI "
-         "annotation instead of the greppable text format.",
+    help="Emit findings (and budget-delta / flow-summary records) as JSON "
+         "lines for editor/CI annotation instead of the greppable text "
+         "format.",
 )
 @click.option(
     "--update-budgets", is_flag=True, default=False,
     help="Re-measure the AOT cost grid and rewrite analysis/BUDGETS.json; "
          "review the diff as perf history.",
 )
-def check(paths, contracts, ir, as_json, update_budgets):
+def check(paths, contracts, ir, flow, as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
     Runs the AST lint rules (MUR001-006: traced branches, host syncs,
     recompilation hazards, import-time allocation, dtype promotion), the
     cross-layer contract checks (MUR101-103), and — for the package check —
-    the jaxpr/HLO IR contracts plus committed cost budgets (MUR200-206).
+    the jaxpr/HLO IR contracts plus committed cost budgets (MUR200-206)
+    and the jaxpr dataflow contracts (MUR800-804: per-neighbor Byzantine
+    influence bounds, NaN/attack scrub dominance, zero-free denominators).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -395,11 +405,11 @@ def check(paths, contracts, ir, as_json, update_budgets):
         run_check_detailed,
     )
 
-    findings, deltas = run_check_detailed(
-        list(paths) or None, contracts=contracts, ir=ir
+    findings, records = run_check_detailed(
+        list(paths) or None, contracts=contracts, ir=ir, flow=flow
     )
     if as_json:
-        out = format_findings_json(findings, deltas)
+        out = format_findings_json(findings, records)
         if out:
             click.echo(out)
         if findings:

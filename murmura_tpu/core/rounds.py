@@ -472,7 +472,13 @@ def build_round_program(
             # for the round.  Its row is REPLACED (not just masked) before
             # any rule math — masked aggregation alone cannot contain a
             # NaN row because 0 * nan == nan in every Gram/matmul path —
-            # and its exchange edges are zeroed both ways.
+            # and its exchange edges are zeroed both ways.  The
+            # where-style replacement here (and the attack-scrub stage
+            # below) is a STATIC contract: `murmura check --flow` MUR803
+            # interval-analyzes this faulted round program with
+            # divergence-capable seeds and fails if non-finiteness can
+            # reach the output params — switching either scrub back to a
+            # multiplicative mask fails the check, not just the runtime.
             finite = jnp.isfinite(own_flat).all(axis=1)
             alive_f = alive if alive is not None else jnp.ones_like(compromised)
             fault_stats["quarantined"] = (

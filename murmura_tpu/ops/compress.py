@@ -165,7 +165,7 @@ class Int8Blocks:
     def padded_p(self) -> int:
         return self.q.shape[1]
 
-    def roll(self, shift: int) -> "Int8Blocks":
+    def roll(self, shift: int) -> "Int8Blocks":  # murmura: traced
         """Roll along the node axis — the circulant neighbor exchange.  On
         a sharded node axis each roll lowers to boundary collective-permutes
         of the int8 payload and the [*, C] scale rows."""
@@ -177,7 +177,7 @@ class Int8Blocks:
             self.out_dtype,
         )
 
-    def slice_blocks(self, start_block, nblocks: int) -> "Int8Blocks":
+    def slice_blocks(self, start_block, nblocks: int) -> "Int8Blocks":  # murmura: traced
         """Static-width slice of ``nblocks`` whole quant blocks starting at
         (possibly traced) block index ``start_block`` — the P-chunking hook
         the exchange kernels use (chunk widths are whole blocks, so scales
@@ -189,7 +189,7 @@ class Int8Blocks:
         s = jax.lax.dynamic_slice(self.scale, (0, start_block), (n, nblocks))
         return Int8Blocks(q, s, self.block, nblocks * self.block, self.out_dtype)
 
-    def dequantize_f32(self) -> jnp.ndarray:
+    def dequantize_f32(self) -> jnp.ndarray:  # murmura: traced
         """[N, padded_p] float32 values (the fused-consumer form: XLA folds
         the convert+scale into whatever elementwise chain reads it, so the
         int8 payload is what HBM serves)."""
@@ -197,13 +197,13 @@ class Int8Blocks:
         qf = self.q.astype(jnp.float32).reshape(n, self.num_blocks, self.block)
         return (qf * self.scale[:, :, None]).reshape(n, self.padded_p)
 
-    def dequantize(self) -> jnp.ndarray:
+    def dequantize(self) -> jnp.ndarray:  # murmura: traced
         """[N, p] values in ``out_dtype`` (padding stripped) — the
         receiver-side tensor rules that do arbitrary math get."""
         return self.dequantize_f32()[:, : self.p].astype(self.out_dtype)
 
 
-def quantize_int8(
+def quantize_int8(  # murmura: traced
     x: jnp.ndarray, block: int, out_dtype=None
 ) -> Int8Blocks:
     """Per-block symmetric int8 quantization of a [N, P] tensor.
@@ -218,7 +218,9 @@ def quantize_int8(
     out_dtype = x.dtype if out_dtype is None else jnp.dtype(out_dtype)
     pad = (-p) % block
     xf = x.astype(jnp.float32)
-    if pad:
+    # Static shape math: p is x.shape[1] and block is a trace-time int —
+    # the name-based taint pass cannot see through the int param.
+    if pad:  # murmura: ignore[MUR001]
         xf = jnp.pad(xf, ((0, 0), (0, pad)))
     nblocks = xf.shape[1] // block
     xb = xf.reshape(n, nblocks, block)
@@ -238,7 +240,7 @@ def quantize_int8(
 # ---------------------------------------------------------------------------
 
 
-def topk_encode(delta: jnp.ndarray, k: int):
+def topk_encode(delta: jnp.ndarray, k: int):  # murmura: traced
     """(values f32 [N, k], indices int32 [N, k]) of the k largest-magnitude
     coordinates per row — the transmitted representation."""
     mag = jnp.abs(delta.astype(jnp.float32))
@@ -248,7 +250,7 @@ def topk_encode(delta: jnp.ndarray, k: int):
     return values, idx
 
 
-def topk_decode(
+def topk_decode(  # murmura: traced
     values: jnp.ndarray, idx: jnp.ndarray, p: int
 ) -> jnp.ndarray:
     """Dense [N, p] float32 reconstruction of the sparse delta (zeros off

@@ -283,7 +283,9 @@ def _candidate_kernel(own_ref, b_ref, out_ref, *, offsets, trim, median):
     else:
         kept = cand[trim : m - trim]
         acc = kept[0]
-        for c in kept[1:]:
+        # Static unroll over a Python list of tracers (len is the static
+        # candidate count) — not traced control flow.
+        for c in kept[1:]:  # murmura: ignore[MUR001]
             acc = acc + c
         res = acc / float(len(kept))
     out_ref[:] = res.astype(out_ref.dtype)

@@ -135,12 +135,51 @@ def build_report(run_dir) -> Dict[str, Any]:
     if taps:
         report["taps"] = taps
 
+    # ---- declared influence contract ------------------------------------
+    # The rule's InfluenceDecl (aggregation/base.py; verified statically by
+    # `murmura check --flow` MUR800-802) doubles as runtime documentation:
+    # rendered next to the observed audit-tap rejection counts so "how much
+    # could a bad neighbor have moved me" sits beside "who actually got
+    # rejected".
+    influence = _declared_influence(manifest)
+    if influence:
+        report["influence"] = influence
+
     counters = manifest.get("counters") or {}
     if counters:
         report["counters"] = counters
     if manifest.get("kind") == KIND_BENCH:
         report["bench"] = manifest.get("summary") or {}
     return report
+
+
+def _declared_influence(manifest: dict) -> Optional[Dict[str, Any]]:
+    """The configured rule's declared Byzantine influence contract, built
+    from the manifest's config snapshot.  Best-effort: bench manifests and
+    pre-influence runs have no (usable) aggregation config."""
+    cfg = manifest.get("config") or {}
+    agg_cfg = cfg.get("aggregation") or {}
+    algo = agg_cfg.get("algorithm")
+    if not algo:
+        return None
+    try:
+        from murmura_tpu.aggregation import build_aggregator
+
+        agg = build_aggregator(
+            algo, dict(agg_cfg.get("params") or {}), model_dim=1,
+            total_rounds=1,
+        )
+    except Exception:  # noqa: BLE001 — stale config snapshots stay renderable
+        return None
+    decl = agg.influence
+    if decl is None:
+        return None
+    return {
+        "rule": algo,
+        "kind": decl.kind,
+        "declared": decl.describe(),
+        "note": decl.note,
+    }
 
 
 def _per_node_sum(rounds: List[dict], key: str) -> Optional[List[float]]:
@@ -239,6 +278,12 @@ def render_report(run_dir, console=None) -> Dict[str, Any]:
         kv_table("Checkpoints", report["checkpoints"])
     if "memory" in report:
         kv_table("Device memory", report["memory"])
+    if "influence" in report:
+        inf = report["influence"]
+        console.print(
+            f"  [cyan]declared influence[/cyan] ({inf['rule']}): "
+            f"{inf['declared']}"
+        )
     if "taps" in report or "faults" in report:
         taps = report.get("taps") or {}
         faults = report.get("faults") or {}

@@ -46,9 +46,12 @@ run() {
 # --ir adds the jaxpr/HLO contracts and the committed AOT cost budgets
 # (MUR200-206): an undeclared collective or a >10% FLOPs drift in any
 # aggregator aborts the battery before a single chip-second is spent.
+# --flow adds the jaxpr dataflow contracts (MUR800-804): a leaked
+# influence bound, a scrub-dominance break, or a zero-capable denominator
+# in any rule/codec likewise aborts before the chip is touched.
 # CPU-pinned so the gate itself cannot wedge the single-tenant TPU.
-echo "=== preflight: murmura check --ir ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
-if ! timeout 600 env JAX_PLATFORMS=cpu python -m murmura_tpu check --ir murmura_tpu/ \
+echo "=== preflight: murmura check --ir --flow ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+if ! timeout 600 env JAX_PLATFORMS=cpu python -m murmura_tpu check --ir --flow murmura_tpu/ \
     > "$OUT/preflight_check.out" 2>&1; then
   echo "preflight murmura check FAILED — aborting battery" | tee -a "$OUT/battery.log"
   cat "$OUT/preflight_check.out" | tee -a "$OUT/battery.log"

@@ -1,0 +1,543 @@
+"""jaxpr dataflow contracts (analysis/flow.py, MUR800-804) — ISSUE 8.
+
+The repo-wide "flow is clean" assertion is TestFlowIsClean (the tier-1
+gate, mirroring test_analysis_contracts.py::TestRepoIsClean); the rest
+pins the *mechanisms*: the taint interpreter's selection-exclusion
+semantics, the interval domain's scrub-pattern recognition, and one
+committed negative per MUR80x rule proving each can fire (ISSUE 8
+acceptance) — including the deliberately-leaky FakeUnboundedKrum.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from murmura_tpu.aggregation.base import AggregatorDef, InfluenceDecl
+from murmura_tpu.analysis import flow
+
+
+class TestFlowIsClean:
+    """The tier-1 CI gate: every future PR must keep the flow contracts
+    clean over all 9 registered rules in every supported exchange mode."""
+
+    def test_check_flow_runs_clean(self):
+        findings = flow.check_flow()
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+        )
+
+    def test_flow_summaries_cover_every_rule_and_mode(self):
+        from murmura_tpu.aggregation import AGGREGATORS
+
+        flow.check_flow()  # memoized — populates the summaries
+        seen = {(s["rule"], s["mode"]) for s in flow.flow_summaries()}
+        for name in AGGREGATORS:
+            for mode in flow.rule_flow_modes(name):
+                assert (name, mode) in seen
+        # The compressed mode runs exactly for the quantized-exchange set.
+        assert ("krum", "compressed") in seen
+        assert ("ubar", "compressed") not in seen
+
+
+class TestTaintInterpreter:
+    """Value-vs-selection dataflow semantics on tiny hand-built programs."""
+
+    def _influence(self, fn, *args, n=4):
+        cell = flow.FlowCell(
+            name="custom", mode="dense", n=n, fn=fn, args=args,
+            bcast_args=(1,), agg=None,
+        )
+        return flow.analyze_cell_influence(cell)
+
+    def test_gather_excludes_index_taint(self):
+        # Output = one selected row; the argmin that CHOSE it is selection
+        # influence and must not taint the result.
+        def fn(own, bcast, adj, ridx, state):
+            score = bcast.sum(axis=1)  # tainted by every row
+            winner = jnp.argmin(score)
+            sel = bcast[jnp.full((own.shape[0],), winner)]
+            return sel, state, {}
+
+        own = jnp.zeros((4, 8))
+        s = self._influence(fn, own, jnp.ones((4, 8)), jnp.ones((4, 4)),
+                            jnp.float32(0), {})
+        assert s["max"] <= 1
+
+    def test_sort_taint_follows_the_permutation(self):
+        def fn(own, bcast, adj, ridx, state):
+            ranked = jnp.sort(bcast, axis=0)
+            return ranked[:1].repeat(own.shape[0], 0), state, {}
+
+        own = jnp.zeros((4, 8))
+        rng = np.random.default_rng(0)
+        b = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+        s = self._influence(fn, own, b, jnp.ones((4, 4)), jnp.float32(0), {})
+        # Each coordinate of the min row is exactly one input element.
+        assert s["max"] <= 1
+
+    def test_zero_weight_kills_taint(self):
+        def fn(own, bcast, adj, ridx, state):
+            w = jnp.asarray([0.0, 0.0, 1.0, 0.0])
+            return own + w[None, :] @ bcast, state, {}
+
+        own = jnp.zeros((4, 8))
+        s = self._influence(fn, own, jnp.ones((4, 8)), jnp.ones((4, 4)),
+                            jnp.float32(0), {})
+        # Only row 2's values flow through the 0/1 weight vector.
+        for i, labels in enumerate(s["sets"]):
+            assert set(labels) - {i} <= {2}
+
+    def test_mean_taints_everything(self):
+        def fn(own, bcast, adj, ridx, state):
+            return jnp.broadcast_to(bcast.mean(0), own.shape), state, {}
+
+        own = jnp.zeros((4, 8))
+        s = self._influence(fn, own, jnp.ones((4, 8)), jnp.ones((4, 4)),
+                            jnp.float32(0), {})
+        assert s["per_node"] == (3, 3, 3, 3)  # all non-self labels
+
+
+def _leaky_fake_krum() -> AggregatorDef:
+    """The FakeUnboundedKrum fixture: *claims* Krum's single-winner bound
+    but actually averages every neighbor — the exact lie MUR800 exists to
+    catch (a 'robust' rule whose math is fedavg)."""
+
+    def aggregate(own, bcast, adj, ridx, state, ctx):
+        degree = adj.sum(axis=1 if adj.ndim == 2 and adj.shape[0] == adj.shape[1] else 0)
+        neighbor_sum = jnp.dot(adj.astype(bcast.dtype), bcast)
+        new_flat = (own + neighbor_sum) / (1.0 + degree)[:, None]
+        return new_flat.astype(own.dtype), state, {}
+
+    return AggregatorDef(
+        name="fake_unbounded_krum",
+        aggregate=aggregate,
+        influence=InfluenceDecl(
+            "bounded", bound=lambda k: 1, note="(a lie)"
+        ),
+    )
+
+
+class TestMUR800InfluenceBound:
+    def test_fake_unbounded_krum_fires(self):
+        agg = _leaky_fake_krum()
+        cell = flow.build_flow_cell("krum", "dense", agg_override=agg)
+        s = flow.analyze_cell_influence(cell)
+        k = len(flow._flow_offsets(flow.FLOW_N))
+        assert s["max"] == k  # the mean leaks the whole neighborhood
+        fs = flow.influence_findings(
+            "fake_unbounded_krum", {"dense": s}, agg.influence, k,
+            anchor=("fake.py", 1),
+        )
+        assert [f.rule for f in fs] == ["MUR800"]
+        assert "leaks influence" in fs[0].message
+        # check --json payload: the per-rule taint sets ride Finding.data.
+        assert fs[0].data["analyzed"] == k
+        assert fs[0].data["declared_bound"] == 1
+        assert len(fs[0].data["taint_sets"]) == flow.FLOW_N
+
+    def test_real_krum_holds_its_bound(self):
+        cell = flow.build_flow_cell("krum", "circulant")
+        s = flow.analyze_cell_influence(cell)
+        assert s["max"] <= 1
+        fs = flow.influence_findings(
+            "krum", {"circulant": s}, cell.agg.influence,
+            len(flow._flow_offsets(flow.FLOW_N)),
+        )
+        assert fs == []
+
+    def test_unknown_primitive_is_a_finding(self):
+        s = {"per_node": (0,), "max": 0, "sets": [[]],
+             "unknown_prims": ["mystery_prim"]}
+        fs = flow.influence_findings(
+            "krum", {"dense": s}, _leaky_fake_krum().influence, 4,
+            anchor=("fake.py", 1),
+        )
+        assert any(
+            f.rule == "MUR800" and "mystery_prim" in f.message for f in fs
+        )
+
+
+class TestMUR801Declaration:
+    def test_missing_declaration_is_a_finding(self):
+        s = {"per_node": (1,), "max": 1, "sets": [[0]], "unknown_prims": []}
+        fs = flow.influence_findings(
+            "undeclared", {"dense": s}, None, 4, anchor=("fake.py", 1)
+        )
+        assert [f.rule for f in fs] == ["MUR801"]
+        assert "declares no influence contract" in fs[0].message
+
+    def test_every_registered_rule_declares(self):
+        from murmura_tpu.aggregation import AGGREGATORS, build_aggregator
+        from murmura_tpu.analysis.ir import AGG_CASES
+
+        for name in AGGREGATORS:
+            agg = build_aggregator(
+                name, dict(AGG_CASES[name]), model_dim=64, total_rounds=5
+            )
+            assert agg.influence is not None, name
+            assert agg.influence.note, name
+
+    def test_decl_validation(self):
+        with pytest.raises(ValueError):
+            InfluenceDecl("bounded")  # bounded needs a bound
+        with pytest.raises(ValueError):
+            InfluenceDecl("unbounded", bound=lambda k: 1)
+        with pytest.raises(ValueError):
+            InfluenceDecl("sometimes")
+
+
+class TestMUR802ModeParity:
+    def test_mode_divergence_is_a_finding(self):
+        sa = {"per_node": (1, 1), "max": 1, "sets": [[0], [1]],
+              "unknown_prims": []}
+        sb = {"per_node": (2, 2), "max": 2, "sets": [[0, 1], [0, 1]],
+              "unknown_prims": []}
+        decl = InfluenceDecl("bounded", bound=lambda k: 2, note="x")
+        fs = flow.influence_findings(
+            "twofaced", {"dense": sa, "circulant": sb}, decl, 4,
+            anchor=("fake.py", 1),
+        )
+        assert [f.rule for f in fs] == ["MUR802"]
+        assert "different per-node influence" in fs[0].message
+
+    def test_unbounded_rules_skip_parity(self):
+        # The dense Gram path's centering couples all rows (a cancellation
+        # the taint domain cannot see) — unbounded rules therefore emit
+        # summaries but are exempt from the cardinality parity check.
+        sa = {"per_node": (4,), "max": 4, "sets": [[0]], "unknown_prims": []}
+        sb = {"per_node": (7,), "max": 7, "sets": [[0]], "unknown_prims": []}
+        decl = InfluenceDecl("unbounded", note="x")
+        fs = flow.influence_findings(
+            "gm", {"dense": sb, "circulant": sa}, decl, 4,
+            anchor=("fake.py", 1),
+        )
+        assert fs == []
+
+
+class TestMUR803ScrubDominance:
+    def _args(self, n=4, p=8):
+        return (jnp.zeros((n, p)), jnp.zeros((n, p)))
+
+    def test_where_scrub_discharges_contamination(self):
+        # The rounds.py sentinel pattern: row-reduced isfinite predicate,
+        # where-style replacement.  Contamination (the log can go -inf)
+        # must NOT survive to the output.
+        def scrubbed(snapshot, update):
+            upd = jnp.log(jnp.abs(update))  # abstractly may be -inf
+            ok = jnp.isfinite(upd).all(axis=1)
+            return (jnp.where(ok[:, None], upd, snapshot),)
+
+        contaminated, events, unknown = flow.scrub_dominance_report(
+            scrubbed, self._args(), check_leading=1
+        )
+        assert contaminated == []
+        assert unknown == []
+
+    def test_multiplicative_mask_is_a_finding(self):
+        # The exact bug class PR 3 fixed by hand: masking a possibly
+        # non-finite row multiplicatively (0 * nan == nan) instead of
+        # replacing it.
+        def mul_masked(snapshot, update):
+            upd = jnp.log(jnp.abs(update))
+            ok = jnp.isfinite(upd).all(axis=1)
+            return (upd * ok[:, None].astype(upd.dtype),)
+
+        contaminated, events, unknown = flow.scrub_dominance_report(
+            mul_masked, self._args(), check_leading=1
+        )
+        assert contaminated  # the product can still be NaN
+        assert any(e["kind"] == "mask-mul" for e in events)
+
+    def test_missing_scrub_is_a_finding(self):
+        def unscrubbed(snapshot, update):
+            return (jnp.log(jnp.abs(update)),)
+
+        contaminated, _events, _unknown = flow.scrub_dominance_report(
+            unscrubbed, self._args(), check_leading=1
+        )
+        assert contaminated
+
+    def test_negated_guard_pattern(self):
+        # The evidential strength-guard shape: where(bad | ~finite, 0, x)
+        # — the FALSE branch carries x, and pred false implies x finite.
+        def guarded(snapshot, update):
+            x = jnp.log(jnp.abs(update))
+            bad = x.sum(axis=1, keepdims=True) > 1e6
+            fin = jnp.isfinite(x)
+            return (jnp.where(bad | ~fin, 0.0, x),)
+
+        contaminated, _e, _u = flow.scrub_dominance_report(
+            guarded, self._args(), check_leading=1
+        )
+        assert contaminated == []
+
+    def test_eq_against_extremum_does_not_constant_fold(self):
+        # x == max(x) is a DATA-DEPENDENT one-hot mask: the same-value
+        # refinement must apply only to literal self-comparison (isnan's
+        # `ne x x`), never through value-changing ops like reduce_max —
+        # else the contaminated else-branch is silently dropped (review
+        # regression).
+        def fn(x, y):
+            yy = jnp.log(jnp.abs(y))
+            return (jnp.where(x == jnp.max(x), x, yy),)
+
+        contaminated, _e, _u = flow.scrub_dominance_report(
+            fn, (jnp.ones(4), jnp.ones(4)), check_leading=1
+        )
+        assert contaminated
+
+    def test_single_output_program_is_supported(self):
+        contaminated, _e, _u = flow.scrub_dominance_report(
+            lambda x: jnp.log(jnp.abs(x)), (jnp.ones(4),), check_leading=1
+        )
+        assert contaminated  # and no crash on the bare (non-tuple) output
+
+    def test_real_faulted_round_programs_are_clean(self):
+        assert flow.check_scrub_dominance() == []
+
+
+class TestMUR804Denominators:
+    def test_unguarded_denominator_is_an_event(self):
+        def leaky(x):
+            return x / x.sum(axis=1, keepdims=True)  # sum can be 0
+
+        events = flow.denominator_events(leaky, (jnp.ones((4, 8)),))
+        assert len(events) == 1
+        assert events[0]["kind"] == "zero-denominator"
+        # Anchored at THIS file's division line via jaxpr source info.
+        assert events[0]["path"] and events[0]["path"].endswith(
+            "test_analysis_flow.py"
+        )
+
+    def test_maximum_guard_clears_it(self):
+        def guarded(x):
+            return x / jnp.maximum(x.sum(axis=1, keepdims=True), 1e-12)
+
+        assert flow.denominator_events(guarded, (jnp.ones((4, 8)),)) == []
+
+    def test_rsqrt_of_zero_capable_operand_fires(self):
+        def leaky(x):
+            return jax.lax.rsqrt(jnp.square(x))
+
+        events = flow.denominator_events(leaky, (jnp.ones((4,)),))
+        assert any(e["prim"] == "rsqrt" for e in events)
+
+    def test_variance_denominator_is_proven_positive(self):
+        # The layernorm pattern: x*x (same var) is nonnegative, jnp.var's
+        # where(count > 0, ..., nan) resolves statically, and the +eps
+        # makes the sqrt denominator provably nonzero.
+        def ln(x):
+            mean = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            return (x - mean) / jnp.sqrt(var + 1e-5)
+
+        assert flow.denominator_events(ln, (jnp.ones((4, 8)),)) == []
+
+    def test_floor_moves_bounds_off_the_input_interval(self):
+        # floor(x) with x in [0.5, 2] reaches 0 — a passthrough transfer
+        # would report the unguarded division clean (review regression).
+        events = flow.denominator_events(
+            lambda x: 1.0 / jnp.floor(x), (jnp.ones(3),),
+            seed_fn=lambda leaves: [flow._iv(0.5, 2.0)],
+        )
+        assert any(e["kind"] == "zero-denominator" for e in events)
+
+    def test_codec_scale_division_is_clean(self):
+        assert flow._codec_denominator_findings() == []
+
+    def test_unguarded_codec_variant_fires(self):
+        # A de-guarded quantizer: the straight 1/scale a careless refactor
+        # would write (all-zero blocks have scale exactly 0).
+        def unguarded_quantize(x):
+            xb = x.reshape(x.shape[0], -1, 32)
+            scale = jnp.max(jnp.abs(xb), axis=-1) / 127.0
+            return jnp.round(xb / scale[:, :, None])
+
+        events = flow.denominator_events(
+            unguarded_quantize, (jnp.zeros((2, 64)),)
+        )
+        assert any(e["kind"] == "zero-denominator" for e in events)
+
+
+class TestIntervalDomain:
+    def _run(self, fn, *args, seeds=None):
+        closed = jax.make_jaxpr(fn)(*args)
+        ev = flow.IntervalEval()
+        if seeds is None:
+            seeds = [flow._iv(-flow._INF, flow._INF)] * len(
+                jax.tree_util.tree_leaves(args)
+            )
+        return ev.eval_closed(closed, seeds), ev
+
+    def test_softplus_floor_survives_the_nan_branch(self):
+        outs, _ = self._run(lambda x: jax.nn.softplus(x) + 1.0, jnp.ones(3))
+        assert outs[0].lo >= 1.0 and not outs[0].nf
+
+    def test_literal_inf_padding_is_clean(self):
+        # Deliberate sort padding must not count as contamination.
+        def pad_sort(x):
+            return jnp.sort(
+                jnp.where(x > 0, x, jnp.inf), axis=0
+            )
+
+        outs, _ = self._run(pad_sort, jnp.ones((4,)))
+        assert not outs[0].nf
+
+    def test_scan_fixpoint_widens(self):
+        def grow(x):
+            def body(c, _):
+                return c * 2.0, c
+
+            c, ys = jax.lax.scan(body, x, jnp.arange(100))
+            return c
+
+        outs, _ = self._run(
+            grow, jnp.ones(()), seeds=[flow._iv(1.0, 2.0)]
+        )
+        assert outs[0].hi == float("inf")
+        assert not outs[0].nf  # growth is unbounded but finite
+
+    def test_reduce_min_all_lowering_keeps_only_true_implications(self):
+        # all() lowered via reduce_min: min TRUE implies every element
+        # true (tif survives); min FALSE only means SOME element is false
+        # (fif must drop) — a guard keyed on all(~isfinite) being false
+        # proves nothing about x (review regression).
+        def fn(snap, upd):
+            x = jnp.log(jnp.abs(upd))
+            bad_all = (~jnp.isfinite(x)).all(axis=1)
+            return (jnp.where(bad_all[:, None], snap, x),)
+
+        contaminated, _e, _u = flow.scrub_dominance_report(
+            fn, (jnp.zeros((4, 8)), jnp.zeros((4, 8))), check_leading=1
+        )
+        assert contaminated
+
+    def test_log2_transfer_uses_base_two(self):
+        # log2(x) - 3.5 with x in [8, 16] straddles 0 (x = 2^3.5); the
+        # natural-log transfer excluded it (review regression).
+        events = flow.denominator_events(
+            lambda x: 1.0 / (jnp.log2(x) - 3.5), (jnp.ones(3),),
+            seed_fn=lambda leaves: [flow._iv(8.0, 16.0)],
+        )
+        assert any(e["kind"] == "zero-denominator" for e in events)
+
+    def test_clamp_outside_window_does_not_invert(self):
+        # clip(d, 0, cap) with d in [5, 6] and cap possibly 0 is exactly
+        # cap — an inverted [5, 0] interval vacuously "excluded" zero
+        # (review regression).
+        events = flow.denominator_events(
+            lambda d, cap: 1.0 / jnp.clip(d, 0.0, cap),
+            (jnp.ones(3), jnp.ones(())),
+            seed_fn=lambda leaves: [flow._iv(5.0, 6.0), flow._iv(0.0, 1.0)],
+        )
+        assert any(e["kind"] == "zero-denominator" for e in events)
+
+    def test_while_loop_joins_zero_iterations(self):
+        def loop(x):
+            return jax.lax.while_loop(
+                lambda c: (c < 10.0).all(), lambda c: c + 1.0, x
+            )
+
+        outs, _ = self._run(loop, jnp.zeros(()), seeds=[flow._iv(0.0, 1.0)])
+        assert outs[0].lo <= 0.0  # the initial carry stays joined in
+
+
+class TestCheckFamilyRegistries:
+    """The check_coverage satellite: families are enumerated from module
+    registries, and an unwired check_* function is a finding."""
+
+    def test_flow_families_registered(self):
+        assert set(flow.FLOW_CHECK_FAMILIES) == {
+            "check_influence", "check_scrub_dominance", "check_denominators",
+        }
+
+    def test_unwired_flow_family_is_a_finding(self, monkeypatch):
+        from murmura_tpu.analysis import ir
+
+        monkeypatch.setattr(
+            flow, "check_rogue", lambda: [], raising=False
+        )
+        fs = [f for f in ir.check_coverage() if "check_rogue" in f.message]
+        assert len(fs) == 1 and fs[0].rule == "MUR205"
+
+    def test_unwired_ir_family_is_a_finding(self, monkeypatch):
+        from murmura_tpu.analysis import ir
+
+        monkeypatch.setattr(ir, "check_rogue", lambda: [], raising=False)
+        fs = [f for f in ir.check_coverage() if "check_rogue" in f.message]
+        assert len(fs) == 1 and fs[0].rule == "MUR205"
+
+    def test_ir_families_run_through_registry(self):
+        from murmura_tpu.analysis import ir
+
+        assert set(ir.IR_CHECK_FAMILIES) == {
+            "check_donation", "check_fault_round", "check_telemetry_taps",
+            "check_gang_round", "check_sparse_exchange",
+            "check_compressed_exchange",
+        }
+
+
+class TestReportInfluence:
+    """Satellite: the declared influence contract doubles as runtime docs —
+    `murmura report` renders it next to the audit-tap rejection counts."""
+
+    def _run_dir(self, tmp_path, algorithm, params=None):
+        import json
+
+        manifest = {
+            "run_id": "r1", "kind": "run", "schema_version": 1,
+            "finalized": True,
+            "config": {
+                "aggregation": {
+                    "algorithm": algorithm, "params": params or {},
+                },
+                "experiment": {"name": "x"},
+            },
+            "history": {
+                "round": [1], "mean_accuracy": [0.5], "mean_loss": [1.0],
+            },
+        }
+        (tmp_path / "manifest.json").write_text(json.dumps(manifest))
+        (tmp_path / "events.jsonl").write_text("")
+        return tmp_path
+
+    def test_bounded_rule_renders_its_contract(self, tmp_path):
+        from murmura_tpu.telemetry.report import build_report
+
+        rep = build_report(
+            self._run_dir(tmp_path, "krum", {"num_compromised": 1})
+        )
+        assert rep["influence"]["kind"] == "bounded"
+        assert "winner" in rep["influence"]["declared"]
+
+    def test_unbounded_rule_says_so(self, tmp_path):
+        from murmura_tpu.telemetry.report import build_report
+
+        rep = build_report(self._run_dir(tmp_path, "fedavg"))
+        assert rep["influence"]["kind"] == "unbounded"
+
+    def test_manifest_without_config_stays_renderable(self, tmp_path):
+        import json
+
+        from murmura_tpu.telemetry.report import build_report
+
+        d = self._run_dir(tmp_path, "fedavg")
+        m = json.loads((d / "manifest.json").read_text())
+        m["config"] = None
+        (d / "manifest.json").write_text(json.dumps(m))
+        assert "influence" not in build_report(d)
+
+
+class TestFlowSuppression:
+    def test_factory_line_suppression_applies(self, tmp_path):
+        from murmura_tpu.analysis.ir import _apply_suppressions
+        from murmura_tpu.analysis.lint import Finding
+
+        f = tmp_path / "fake_rule.py"
+        f.write_text("def make_fake():  # murmura: ignore[MUR800]\n    pass\n")
+        kept = _apply_suppressions([
+            Finding("MUR800", str(f), 1, "leak"),
+            Finding("MUR802", str(f), 1, "parity"),
+        ])
+        assert [x.rule for x in kept] == ["MUR802"]

@@ -168,6 +168,22 @@ def probe_backend(attempts: int = 3, timeout_s: float = None,
     return "cpu-fallback", "", log
 
 
+def fallback_reason_from_probe(backend: str, probe_log) -> "str | None":
+    """Why a sweep is NOT on the chip (None when it is) — the one
+    derivation bench.py and bench_scaling.py both stamp into their
+    artifacts, so the r03-r05 fallback attribution cannot drift between
+    sweeps."""
+    if "cpu" not in backend:
+        return None
+    if backend == "cpu-fallback":
+        errs = [r.get("err") for r in probe_log if r.get("err")]
+        return (
+            f"TPU probe failed: {errs[-1]}" if errs
+            else "TPU probe failed (no attempt succeeded)"
+        )
+    return "default jax backend is cpu (no TPU attached)"
+
+
 def _peak_flops(device_kind: str):
     kind = device_kind.lower()
     for key, peak in PEAK_FLOPS.items():
@@ -239,12 +255,51 @@ def build_network(on_cpu: bool, num_nodes: int = 20,
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "--require-tpu", action="store_true",
+        help="Abort loudly (exit 2) instead of falling back to CPU when "
+             "the TPU probe fails — no more CPU numbers labeled by hope "
+             "(BENCH r03-r05).  Env twin: MURMURA_REQUIRE_TPU=1.",
+    )
+    args = ap.parse_args()
+    require = (
+        args.require_tpu or os.environ.get("MURMURA_REQUIRE_TPU") == "1"
+    )
+
     backend, device_kind, probe_log = probe_backend()
     on_cpu = "cpu" in backend
+    # Why this run is (or is not) on the chip — stamped into the output
+    # JSON so a fallback is attributable in the artifact itself, not just
+    # the probe log (the r03-r05 mislabeling fix).
+    fallback_reason = fallback_reason_from_probe(backend, probe_log)
+    if require and on_cpu:
+        print(
+            f"bench: --require-tpu/MURMURA_REQUIRE_TPU set but the run "
+            f"would execute on CPU ({fallback_reason}); aborting instead "
+            "of benchmarking the wrong platform",
+            file=sys.stderr, flush=True,
+        )
+        raise SystemExit(2)
     if on_cpu:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    elif require:
+        # The probe subprocess saw a TPU; verify THIS process got one too
+        # before any number is measured (the tunnel can die in between).
+        from murmura_tpu.durability.dispatch import (
+            BackendRequirementError,
+            require_tpu,
+        )
+
+        try:
+            require_tpu(source="--require-tpu (bench)")
+        except BackendRequirementError as e:
+            print(f"bench: {e}", file=sys.stderr, flush=True)
+            raise SystemExit(2)
 
     timed_rounds = 5 if on_cpu else 20
 
@@ -493,6 +548,12 @@ def main():
                     "unit": "rounds/sec",
                     "vs_baseline": round(rounds_per_sec / 50.0, 4),
                     "backend": backend,
+                    # The platform the numbers were actually measured on,
+                    # and — when that is not the chip — why (None on TPU).
+                    # Stamped so a fallback is attributable in the
+                    # artifact itself (the r03-r05 mislabeling fix).
+                    "platform": "cpu" if on_cpu else backend,
+                    "fallback_reason": fallback_reason,
                     "device_kind": device_kind,
                     "param_dtype": best["param_dtype"],
                     "probe_log": probe_log,

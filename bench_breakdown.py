@@ -183,6 +183,9 @@ def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
 
 
 def main():
+    import os
+    import sys
+
     from murmura_tpu.topology.generators import create_topology
 
     global SMOKE
@@ -194,9 +197,28 @@ def main():
                     help="flagship scenario scale (256 = the north-star "
                          "shape; writes bench_breakdown_<N>node.json and "
                          "skips the 10-node probe scenario)")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="Abort loudly (exit 2) unless the default jax "
+                         "backend is a TPU — no silent CPU numbers in a "
+                         "TPU artifact.  Env twin: MURMURA_REQUIRE_TPU=1. "
+                         "Ignored under --smoke (an explicit CPU check).")
     args_ns = ap.parse_args()
     SMOKE = args_ns.smoke
     nodes = args_ns.nodes
+    if (
+        (args_ns.require_tpu or os.environ.get("MURMURA_REQUIRE_TPU") == "1")
+        and not SMOKE
+    ):
+        from murmura_tpu.durability.dispatch import (
+            BackendRequirementError,
+            require_tpu,
+        )
+
+        try:
+            require_tpu(source="--require-tpu (bench_breakdown)")
+        except BackendRequirementError as e:
+            print(f"bench_breakdown: {e}", file=sys.stderr, flush=True)
+            raise SystemExit(2)
 
     results = {}
     adj = None
@@ -295,6 +317,7 @@ def main():
         blob = {
             "device_kind": jax.devices()[0].device_kind,
             "backend": jax.default_backend(),
+            **_platform_stamp(),
             "num_nodes": nodes,
             "segments": seg,
             "raw": results,
@@ -348,6 +371,7 @@ def main():
     blob = {
         "device_kind": jax.devices()[0].device_kind,
         "backend": jax.default_backend(),
+        **_platform_stamp(),
         "segments": seg,
         "probe_scenario": {
             "config": "evidential_trust, 10-node fully, UCI-HAR-shaped, "
@@ -366,6 +390,22 @@ def main():
         name = "bench_breakdown"
     _write_artifact(name, blob, out)
     print(json.dumps(blob))
+
+
+def _platform_stamp() -> dict:
+    """``platform`` + ``fallback_reason`` for every bench JSON: the
+    platform the numbers were actually measured on, and why when that is
+    not the chip (None on TPU) — a CPU artifact must say so itself, not
+    rely on whoever reads the filename (the BENCH r03-r05 mislabeling
+    fix)."""
+    backend = jax.default_backend()
+    return {
+        "platform": backend,
+        "fallback_reason": None if backend == "tpu" else (
+            f"default jax backend is {backend} (no TPU attached or "
+            "platform pinned by env)"
+        ),
+    }
 
 
 def _write_artifact(name: str, blob: dict, legacy_name: str) -> None:

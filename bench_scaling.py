@@ -20,6 +20,7 @@ Writes bench_scaling.json (committed) and prints it.
 
 import argparse
 import json
+import os
 import resource
 import subprocess
 import sys
@@ -64,13 +65,35 @@ SPARSE_POINTS = [
 
 
 def run_point(
-    nodes: int, algo: str, exchange: str, on_cpu: bool, variant: str = ""
+    nodes: int, algo: str, exchange: str, on_cpu: bool, variant: str = "",
+    require_tpu: bool = False,
 ) -> None:
     """Child-process body: one scaling point, one JSON line on stdout."""
     import jax
 
     if on_cpu:
         jax.config.update("jax_platforms", "cpu")
+    elif require_tpu or os.environ.get("MURMURA_REQUIRE_TPU") == "1":
+        # The parent's probe saw a TPU, but THIS process initializes JAX
+        # independently — a tunnel that died between points would silently
+        # degrade this point to CPU and poison the sweep (the r03–r05
+        # mislabeling).  Abort loudly instead.
+        from murmura_tpu.durability.dispatch import (
+            BackendRequirementError,
+            require_tpu as _require,
+        )
+
+        try:
+            _require("bench_scaling --point (--require-tpu)")
+        except BackendRequirementError as e:
+            # One line + exit 2, like bench.py — the parent records the
+            # point as failed with THIS message, not a raw traceback.
+            print(f"bench_scaling --point: {e}", file=sys.stderr, flush=True)
+            raise SystemExit(2)
+    # The backend THIS point actually ran on — stamped per point because
+    # each --point subprocess can fall back independently of the parent's
+    # one-time probe.
+    point_platform = jax.default_backend()
 
     import jax.numpy as jnp
     import numpy as np
@@ -264,6 +287,7 @@ def run_point(
         "nodes": nodes,
         "algo": algo,
         "exchange": exchange,
+        "platform": point_platform,
         # Effective variant actually built (the CPU fallback forces tiny).
         "variant": model_params.get("variant", "baseline"),
         "rounds_per_sec": round(rounds_per_sec, 4),
@@ -291,6 +315,10 @@ def main():
     ap.add_argument("--variant", default="",
                     help="internal: model variant override for --point")
     ap.add_argument("--cpu", action="store_true")
+    ap.add_argument("--require-tpu", action="store_true",
+                    help="Abort loudly (exit 2) instead of falling back "
+                         "to CPU when the TPU probe fails.  Env twin: "
+                         "MURMURA_REQUIRE_TPU=1.")
     ap.add_argument("--sparse", action="store_true",
                     help="run the exponential-graph sparse-exchange cells "
                          "(N in {256, 1024, 4096}) instead of the dense/"
@@ -306,13 +334,28 @@ def main():
 
     if args.point:
         run_point(int(args.point[0]), args.point[1], args.point[2], args.cpu,
-                  variant=args.variant)
+                  variant=args.variant, require_tpu=args.require_tpu)
         return
 
-    from bench import probe_backend
+    from bench import fallback_reason_from_probe, probe_backend
 
     backend, device_kind, probe_log = probe_backend()
     on_cpu = "cpu" in backend
+    if on_cpu:
+        fallback_reason = fallback_reason_from_probe(backend, probe_log)
+        if (
+            args.require_tpu
+            or os.environ.get("MURMURA_REQUIRE_TPU") == "1"
+        ):
+            print(
+                f"bench_scaling: --require-tpu/MURMURA_REQUIRE_TPU set "
+                f"but the sweep would run on CPU ({fallback_reason}); "
+                "aborting instead of benchmarking the wrong platform",
+                file=sys.stderr, flush=True,
+            )
+            raise SystemExit(2)
+    else:
+        fallback_reason = None
 
     results = []
 
@@ -321,6 +364,8 @@ def main():
         # wedged tunnel) still leaves the completed points on disk.
         blob = {
             "backend": backend,
+            "platform": "cpu" if on_cpu else backend,
+            "fallback_reason": fallback_reason,
             "device_kind": device_kind,
             "probe_log": probe_log,
             "complete": done,
@@ -336,6 +381,8 @@ def main():
             cmd += ["--variant", p["variant"]]
         if on_cpu:
             cmd.append("--cpu")
+        if args.require_tpu:
+            cmd.append("--require-tpu")
         print(f"[{p['nodes']:>3} nodes {p['algo']}/{p['exchange']}] ...",
               file=sys.stderr, flush=True)
         try:

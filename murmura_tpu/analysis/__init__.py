@@ -40,17 +40,22 @@ def run_check_detailed(
     ir: Optional[bool] = None,
     budget_path=None,
     flow: Optional[bool] = None,
+    durability: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
     The pass layers: AST lint over ``paths`` (default: the installed
     murmura_tpu package), the cross-layer contract checks, when ``ir`` is
     enabled the jaxpr/HLO IR contracts (analysis/ir.py, MUR200-205) plus
-    the AOT cost-budget sweep (analysis/budgets.py, MUR206), and when
-    ``flow`` is enabled the jaxpr dataflow contracts (analysis/flow.py,
-    MUR800-804).  ``ir=None``/``flow=None`` mean "on for the package
-    check, off for explicit paths" (both passes are package-global: they
-    trace the live registry, not the files named on the command line).
+    the AOT cost-budget sweep (analysis/budgets.py, MUR206), when ``flow``
+    is enabled the jaxpr dataflow contracts (analysis/flow.py,
+    MUR800-804), and when ``durability`` is enabled the executable
+    resume-determinism contract (analysis/durability.py, MUR901/902:
+    save→restore→replay byte-equality + zero-recompile restore per
+    rule x exchange mode).  ``ir=None``/``flow=None``/``durability=None``
+    mean "on for the package check, off for explicit paths" (all three
+    passes are package-global: they exercise the live registry, not the
+    files named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -60,6 +65,7 @@ def run_check_detailed(
     """
     run_ir = ir if ir is not None else not paths
     run_flow = flow if flow is not None else not paths
+    run_durability = durability if durability is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -79,6 +85,10 @@ def run_check_detailed(
 
         findings.extend(flow_mod.check_flow())
         records.extend(flow_mod.flow_summaries())
+    if run_durability:
+        from murmura_tpu.analysis import durability as durability_mod
+
+        findings.extend(durability_mod.check_durability())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -88,10 +98,13 @@ def run_check(
     contracts: bool = True,
     ir: Optional[bool] = None,
     flow: Optional[bool] = None,
+    durability: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
-    return run_check_detailed(paths, contracts=contracts, ir=ir, flow=flow)[0]
+    return run_check_detailed(
+        paths, contracts=contracts, ir=ir, flow=flow, durability=durability
+    )[0]
 
 
 def format_findings(findings: Iterable[Finding]) -> str:

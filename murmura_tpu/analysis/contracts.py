@@ -360,4 +360,126 @@ def check_contracts(tests_dir: Optional[Path] = None) -> List[Finding]:
                 "versions' — a schema bump must document how existing run "
                 "directories migrate",
             ))
+
+    # -- MUR900: snapshot completeness bijection ----------------------------
+    # The durability snapshot (durability/snapshot.py) promises to carry
+    # EVERY piece of state the run carries across rounds.  Two halves keep
+    # that promise machine-checked: (a) every reserved ``*_STATE_KEYS``
+    # tuple in the package must be registered with the snapshot module (an
+    # unregistered group is carried state the completeness contract cannot
+    # see), and (b) a payload containing every reserved key must survive
+    # the save→restore roundtrip byte-for-byte.
+    dur_path = str(pkg / "durability" / "snapshot.py")
+    try:
+        from murmura_tpu.durability import snapshot as dsnap
+    except Exception as e:  # noqa: BLE001 — the import failure IS the finding
+        findings.append(Finding(
+            "MUR900", dur_path, 1,
+            f"durability.snapshot failed to import ({type(e).__name__}: "
+            f"{e}) — the snapshot completeness contract cannot be checked",
+        ))
+        return findings
+    findings += _mur900_registry_findings(
+        dsnap.discover_state_key_groups(pkg),
+        dsnap.RESERVED_AGG_STATE_KEY_GROUPS,
+        dur_path,
+    )
+    findings += _mur900_roundtrip_findings(dur_path)
+    return findings
+
+
+def _mur900_registry_findings(
+    discovered, registry, dur_path: str
+) -> List[Finding]:
+    """MUR900 half (a): the discovered ``*_STATE_KEYS`` assignments and
+    the durability registry must name the same groups, in the same
+    modules.  Split out for negative-testability (the _sync_findings
+    pattern)."""
+    findings: List[Finding] = []
+    for name, module in sorted(discovered.items()):
+        reg = registry.get(name)
+        if reg is None:
+            findings.append(Finding(
+                "MUR900", dur_path, 1,
+                f"reserved carried-state key group '{name}' ({module}) is "
+                "not registered in durability.snapshot."
+                "RESERVED_AGG_STATE_KEY_GROUPS — state it reserves would "
+                "be invisible to the snapshot completeness contract; "
+                "register it",
+            ))
+        elif reg != module:
+            findings.append(Finding(
+                "MUR900", dur_path, 1,
+                f"carried-state key group '{name}' is registered under "
+                f"module '{reg}' but defined in '{module}' — fix the "
+                "registry entry",
+            ))
+    for name in sorted(set(registry) - set(discovered)):
+        findings.append(Finding(
+            "MUR900", dur_path, 1,
+            f"RESERVED_AGG_STATE_KEY_GROUPS entry '{name}' names no "
+            "module-level *_STATE_KEYS assignment in the package — remove "
+            "the stale registry entry",
+        ))
+    return findings
+
+
+def _mur900_roundtrip_findings(dur_path: str) -> List[Finding]:
+    """MUR900 half (b): an assembled payload carrying every base section
+    and every reserved agg_state key must survive the snapshot
+    save→restore roundtrip byte-for-byte."""
+    import tempfile
+
+    import numpy as np
+
+    from murmura_tpu.durability import snapshot as dsnap
+
+    findings: List[Finding] = []
+    try:
+        groups = dsnap.resolve_reserved_agg_state_keys()
+    except Exception as e:  # noqa: BLE001 — a stale entry IS the finding
+        return [Finding(
+            "MUR900", dur_path, 1,
+            f"RESERVED_AGG_STATE_KEY_GROUPS failed to resolve "
+            f"({type(e).__name__}: {e}) — registry entries must import to "
+            "non-empty tuples of agg_state key strings",
+        )]
+    rng = np.random.default_rng(0)
+    agg_state = {"ordinary_stat": rng.normal(size=(4,)).astype(np.float32)}
+    for keys in groups.values():
+        for k in keys:
+            agg_state[k] = rng.normal(size=(4, 3)).astype(np.float32)
+    payload = {
+        "params": {"w": rng.normal(size=(4, 2)).astype(np.float32)},
+        "agg_state": agg_state,
+        "rng": np.zeros(2, np.uint32),
+        "round": 3,
+        "history": {"round": [1, 2, 3]},
+        "round_times": [0.1, 0.2, 0.3],
+    }
+    try:
+        with tempfile.TemporaryDirectory() as d:
+            missing, corrupted = dsnap.snapshot_roundtrip_missing_sections(
+                d, payload
+            )
+    except Exception as e:  # noqa: BLE001 — a broken writer IS the finding
+        return [Finding(
+            "MUR900", dur_path, 1,
+            f"the snapshot roundtrip probe crashed ({type(e).__name__}: "
+            f"{e}) — the save/restore path cannot carry the reserved "
+            "state",
+        )]
+    for section in missing:
+        findings.append(Finding(
+            "MUR900", dur_path, 1,
+            f"snapshot base section '{section}' did not survive the "
+            "save→restore roundtrip — the snapshot payload is incomplete",
+        ))
+    for key in corrupted:
+        findings.append(Finding(
+            "MUR900", dur_path, 1,
+            f"reserved carried-state key '{key}' was lost or corrupted by "
+            "the snapshot roundtrip — a resumed run would silently drop "
+            "this subsystem's carried state",
+        ))
     return findings

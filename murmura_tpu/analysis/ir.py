@@ -123,8 +123,11 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 
 # Entry points / meta-checks that are wired elsewhere by design: check_ir
 # IS the runner, check_coverage runs first inside it, and analysis/flow's
-# check_flow is its own runner composed by run_check_detailed.
-_CHECK_ENTRY_POINTS = frozenset({"check_ir", "check_coverage", "check_flow"})
+# check_flow / analysis/durability's check_durability are their own
+# runners composed by run_check_detailed.
+_CHECK_ENTRY_POINTS = frozenset(
+    {"check_ir", "check_coverage", "check_flow", "check_durability"}
+)
 
 
 def _ir_family(crash_rule: str, crash_anchor: str):
@@ -1609,11 +1612,13 @@ def check_coverage() -> List[Finding]:
     """MUR205: registry <-> canonical-case bijection (the MUR101
     counterpart that keeps every other MUR2xx rule non-vacuous), plus the
     check-family wiring audit: every module-level ``check_*`` function in
-    analysis/ir.py and analysis/flow.py must be enumerated by its module's
-    check-family registry (IR_CHECK_FAMILIES / FLOW_CHECK_FAMILIES) —
-    enumeration comes from the registry, never a hand-maintained call
-    list, so a future MUR family that is written but not wired into
-    ``check_ir``/``check_flow`` is a finding, not a silent gap."""
+    analysis/ir.py, analysis/flow.py and analysis/durability.py must be
+    enumerated by its module's check-family registry (IR_CHECK_FAMILIES /
+    FLOW_CHECK_FAMILIES / DURABILITY_CHECK_FAMILIES) — enumeration comes
+    from the registry, never a hand-maintained call list, so a future MUR
+    family that is written but not wired into
+    ``check_ir``/``check_flow``/``check_durability`` is a finding, not a
+    silent gap."""
     import sys
 
     from murmura_tpu.aggregation import AGGREGATORS
@@ -1635,6 +1640,7 @@ def check_coverage() -> List[Finding]:
             f"AGG_CASES entry '{name}' names no registered aggregation "
             "rule — remove the stale canonical case",
         ))
+    from murmura_tpu.analysis import durability as durability_mod
     from murmura_tpu.analysis import flow as flow_mod
 
     findings.extend(
@@ -1642,6 +1648,11 @@ def check_coverage() -> List[Finding]:
     )
     findings.extend(
         _unwired_family_findings(flow_mod, flow_mod.FLOW_CHECK_FAMILIES)
+    )
+    findings.extend(
+        _unwired_family_findings(
+            durability_mod, durability_mod.DURABILITY_CHECK_FAMILIES
+        )
     )
     return findings
 

@@ -57,17 +57,152 @@ def app():
     """murmura_tpu: TPU-native decentralized federated learning."""
 
 
+def _resolve_durability(config, checkpoint_dir, checkpoint_every, resume,
+                        retries):
+    """Merge the CLI durability flags over the config's ``durability:``
+    block (explicit flag wins; ``None`` means "not given")."""
+    d = config.durability
+    if checkpoint_dir is None and d.checkpoint_dir is not None:
+        checkpoint_dir = Path(d.checkpoint_dir)
+    if checkpoint_every is None:
+        checkpoint_every = d.checkpoint_every
+    if resume is None:
+        resume = d.resume
+    if retries is None:
+        retries = d.retries
+    if resume and checkpoint_dir is None:
+        raise click.UsageError("--resume requires --checkpoint-dir")
+    if retries and checkpoint_dir is None:
+        raise click.UsageError(
+            "--retries requires --checkpoint-dir: a transient-failure "
+            "retry restores from the last snapshot before re-dispatching "
+            "(retrying consumed/donated buffers without a restore is "
+            "never safe)"
+        )
+    if checkpoint_dir is not None and not resume:
+        from murmura_tpu.utils.checkpoint import has_checkpoint
+
+        if has_checkpoint(checkpoint_dir):
+            # A fresh run would clobber the existing snapshot — and worse,
+            # a retry before this run's first snapshot would silently
+            # restore the STALE one and return the old run's history.
+            raise click.UsageError(
+                f"{checkpoint_dir} already holds a snapshot; pass --resume "
+                "to continue that run, or point --checkpoint-dir at a "
+                "clean directory"
+            )
+    return checkpoint_dir, checkpoint_every, resume, retries
+
+
+def _train_with_retries(orchestrator, train, *, retries, config,
+                        checkpoint_dir):
+    """The shared retry envelope for `run` and `_run_sweep`:
+    ``train()`` dispatches (computing remaining rounds itself, so a
+    restored round counter is respected); on a classified-transient
+    failure the orchestrator is restored from its last snapshot before
+    re-dispatching — retrying consumed (donated) buffers without a
+    restore is never safe, so an attempt with no snapshot to restore
+    refuses loudly instead."""
+
+    def _attempt(try_idx: int):
+        if try_idx > 0:
+            from murmura_tpu.utils.checkpoint import has_checkpoint
+
+            if not has_checkpoint(checkpoint_dir):
+                raise RuntimeError(
+                    f"transient failure before the first snapshot landed "
+                    f"in {checkpoint_dir} — nothing to restore, so a "
+                    "retry is not donation-safe; rerun from scratch "
+                    "(lower durability.checkpoint_every to shrink this "
+                    "window)"
+                )
+            done = orchestrator.restore_checkpoint(str(checkpoint_dir))
+            console.print(
+                f"Retry {try_idx}: restored round [bold]{done}[/bold]"
+            )
+        return train()
+
+    if not retries:
+        return _attempt(0)
+    from murmura_tpu.durability.dispatch import RetryPolicy, run_with_retry
+
+    writers = orchestrator.telemetry
+    if not isinstance(writers, (list, tuple)):
+        writers = [writers]
+
+    def _on_retry(exc, try_idx, delay):
+        reason = f"{type(exc).__name__}: {exc}"[:300]
+        console.print(
+            f"[yellow]Transient failure ({escape(reason)}); "
+            f"retry {try_idx}/{retries} in {delay:.1f}s[/yellow]"
+        )
+        for t in writers:
+            if t is not None:
+                t.emit(
+                    "backend_degraded", reason=reason, retry=try_idx,
+                    delay_s=round(delay, 2),
+                    round=orchestrator.current_round,
+                )
+
+    return run_with_retry(
+        _attempt,
+        policy=RetryPolicy(
+            max_retries=retries,
+            base_delay_s=config.durability.retry_base_delay_s,
+            max_delay_s=config.durability.retry_max_delay_s,
+        ),
+        on_retry=_on_retry,
+    )
+
+
+def _enforce_require_tpu(config, require_tpu_flag: bool) -> None:
+    """The --require-tpu / durability.require_tpu / MURMURA_REQUIRE_TPU=1
+    hard-fail: abort loudly instead of silently falling back to CPU."""
+    from murmura_tpu.durability.dispatch import (
+        BackendRequirementError,
+        require_tpu,
+        tpu_required,
+    )
+
+    if not (require_tpu_flag or tpu_required(config)):
+        return
+    try:
+        require_tpu(
+            source="--require-tpu" if require_tpu_flag
+            else "durability.require_tpu/MURMURA_REQUIRE_TPU"
+        )
+    except BackendRequirementError as e:
+        console.print(f"[bold red]{escape(str(e))}[/bold red]")
+        raise SystemExit(2)
+
+
 @app.command()
 @click.argument("config_path", type=click.Path(exists=True, path_type=Path))
 @click.option("--verbose/--quiet", "verbose", default=None, help="Override config verbosity")
 @click.option("--output", "-o", type=click.Path(path_type=Path), default=None,
               help="Write history JSON here")
 @click.option("--checkpoint-dir", type=click.Path(path_type=Path), default=None,
-              help="Write per-round checkpoints here (simulation/tpu backends)")
-@click.option("--checkpoint-every", type=int, default=5,
-              help="Rounds between checkpoints (with --checkpoint-dir)")
-@click.option("--resume/--no-resume", default=False,
-              help="Resume from --checkpoint-dir if a checkpoint exists")
+              help="Snapshot the complete run state here (simulation/tpu "
+                   "backends; single runs, gangs and population streaming "
+                   "alike — durability/snapshot.py). Default: "
+                   "durability.checkpoint_dir")
+@click.option("--checkpoint-every", type=int, default=None,
+              help="Rounds between checkpoints (with --checkpoint-dir; "
+                   "default: durability.checkpoint_every)")
+@click.option("--resume/--no-resume", default=None,
+              help="Resume from --checkpoint-dir if a snapshot exists "
+                   "(byte-identical continuation, telemetry stream "
+                   "appends; default: durability.resume)")
+@click.option("--require-tpu", is_flag=True, default=False,
+              help="Abort loudly unless the default JAX backend is a TPU "
+                   "— replaces the silent CPU fallback. Env twin: "
+                   "MURMURA_REQUIRE_TPU=1; config twin: "
+                   "durability.require_tpu")
+@click.option("--retries", type=int, default=None,
+              help="Retry the training dispatch on classified-transient "
+                   "errors (device/tunnel), restoring from the last "
+                   "snapshot with exponential backoff + jitter. Requires "
+                   "--checkpoint-dir. Default: durability.retries")
 @click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
               help="Force the JAX platform (reference: cli.py:37 device override)")
 @click.option("--profile", "profile", is_flag=True, default=False,
@@ -80,7 +215,7 @@ def app():
                    "vmapped program — sugar for `murmura sweep` with "
                    "num_seeds: N (docs/PERFORMANCE.md). 1 = normal run.")
 def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
-        resume, device, profile, num_seeds):
+        resume, require_tpu, retries, device, profile, num_seeds):
     """Run an experiment from a config file (reference: cli.py:34-60)."""
     if num_seeds is not None and num_seeds < 1:
         raise click.UsageError(
@@ -88,11 +223,10 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
             "N > 1 gang-batches N seeds"
         )
     if num_seeds is not None and num_seeds > 1:
-        if resume or checkpoint_dir is not None or profile:
+        if profile:
             raise click.UsageError(
                 "--seeds (gang-batched execution) does not combine with "
-                "--resume/--checkpoint-dir/--profile; use `murmura sweep` "
-                "semantics (per-member telemetry manifests instead)"
+                "--profile; profile a single run instead"
             )
         config = _load_config_or_die(config_path)
         if verbose is not None:
@@ -100,7 +234,9 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         base = config.experiment.seed
         return _run_sweep(
             config, seeds=[base + i for i in range(num_seeds)],
-            output=output, device=device,
+            output=output, device=device, checkpoint_dir=checkpoint_dir,
+            checkpoint_every=checkpoint_every, resume=resume,
+            require_tpu=require_tpu, retries=retries,
         )
     if device is not None:
         # Must land before anything initializes the XLA backend.
@@ -110,6 +246,10 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
     config = _load_config_or_die(config_path)
     if verbose is not None:
         config.experiment.verbose = verbose
+    checkpoint_dir, checkpoint_every, resume, retries = _resolve_durability(
+        config, checkpoint_dir, checkpoint_every, resume, retries
+    )
+    _enforce_require_tpu(config, require_tpu)
     if profile:
         if config.backend == "distributed":
             raise click.UsageError(
@@ -124,12 +264,6 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
     population_on = (
         config.population is not None and config.population.enabled
     )
-    if population_on and (resume or checkpoint_dir is not None):
-        raise click.UsageError(
-            "--checkpoint-dir/--resume are not supported with population "
-            "(cohort streaming): run state spans the host-side user bank "
-            "plus the resident cohort"
-        )
     extra = ""
     if population_on:
         extra = (
@@ -164,15 +298,21 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
         )
 
         try:
-            network = build_network_from_config(config, telemetry_resume=resume)
+            # checkpoint_dir (resume path) makes the telemetry stream
+            # append exactly when a snapshot exists — a resumed run never
+            # rotates its own events to *.prev (durability satellite).
+            network = build_network_from_config(
+                config,
+                checkpoint_dir=(
+                    str(checkpoint_dir) if resume and checkpoint_dir else None
+                ),
+            )
         except ConfigError as e:
             # Wiring-level config errors (data/model mismatch, unsupported
             # exchange mode, ...) — render the message, not the traceback.
             # Unexpected exceptions stay loud.
             _die_config_error(e)
         if resume:
-            if checkpoint_dir is None:
-                raise click.UsageError("--resume requires --checkpoint-dir")
             from murmura_tpu.utils.checkpoint import has_checkpoint
 
             if has_checkpoint(checkpoint_dir):
@@ -183,13 +323,19 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
                     f"[yellow]No checkpoint in {checkpoint_dir}; "
                     "starting from round 0[/yellow]"
                 )
-        remaining = config.experiment.rounds - network.current_round
-        history = network.train(
-            rounds=max(0, remaining),
-            verbose=config.experiment.verbose,
-            checkpoint_dir=str(checkpoint_dir) if checkpoint_dir else None,
-            checkpoint_every=checkpoint_every,
-            rounds_per_dispatch=config.tpu.rounds_per_dispatch,
+
+        history = _train_with_retries(
+            network,
+            lambda: network.train(
+                rounds=max(
+                    0, config.experiment.rounds - network.current_round
+                ),
+                verbose=config.experiment.verbose,
+                checkpoint_dir=str(checkpoint_dir) if checkpoint_dir else None,
+                checkpoint_every=checkpoint_every,
+                rounds_per_dispatch=config.tpu.rounds_per_dispatch,
+            ),
+            retries=retries, config=config, checkpoint_dir=checkpoint_dir,
         )
 
     _display_results(history)
@@ -208,19 +354,31 @@ def run(config_path: Path, verbose, output, checkpoint_dir, checkpoint_every,
     return history
 
 
-def _run_sweep(config, seeds, output, device):
+def _run_sweep(config, seeds, output, device, checkpoint_dir=None,
+               checkpoint_every=None, resume=None, require_tpu=False,
+               retries=None):
     """Shared gang-sweep driver (`murmura sweep` and `murmura run --seeds`):
-    build the gang, train, render the per-member summary, write per-member
-    histories."""
+    build the gang, optionally resume it from its durability snapshot,
+    train (retry-wrapped like single runs), render the per-member summary,
+    write per-member histories."""
     if device is not None:
         # Must land before anything initializes the XLA backend.
         import jax
 
         jax.config.update("jax_platforms", device)
+    checkpoint_dir, checkpoint_every, resume, retries = _resolve_durability(
+        config, checkpoint_dir, checkpoint_every, resume, retries
+    )
+    _enforce_require_tpu(config, require_tpu)
     from murmura_tpu.utils.factories import ConfigError, build_gang_from_config
 
     try:
-        gang = build_gang_from_config(config, seeds=seeds)
+        gang = build_gang_from_config(
+            config, seeds=seeds,
+            checkpoint_dir=(
+                str(checkpoint_dir) if resume and checkpoint_dir else None
+            ),
+        )
     except ConfigError as e:
         _die_config_error(e)
     console.print(
@@ -230,10 +388,30 @@ def _run_sweep(config, seeds, output, device):
         f"rounds={config.experiment.rounds}, "
         f"gang={gang.gang_size} member(s), batch={gang.batch})"
     )
-    histories = gang.train(
-        rounds=config.experiment.rounds,
-        verbose=config.experiment.verbose,
-        rounds_per_dispatch=config.tpu.rounds_per_dispatch,
+    if resume:
+        from murmura_tpu.utils.checkpoint import has_checkpoint
+
+        if has_checkpoint(checkpoint_dir):
+            done = gang.restore_checkpoint(str(checkpoint_dir))
+            console.print(
+                f"Resumed all {gang.gang_size} member(s) from round "
+                f"[bold]{done}[/bold]"
+            )
+        else:
+            console.print(
+                f"[yellow]No checkpoint in {checkpoint_dir}; "
+                "starting from round 0[/yellow]"
+            )
+    histories = _train_with_retries(
+        gang,
+        lambda: gang.train(
+            rounds=max(0, config.experiment.rounds - gang.current_round),
+            verbose=config.experiment.verbose,
+            rounds_per_dispatch=config.tpu.rounds_per_dispatch,
+            checkpoint_dir=str(checkpoint_dir) if checkpoint_dir else None,
+            checkpoint_every=checkpoint_every,
+        ),
+        retries=retries, config=config, checkpoint_dir=checkpoint_dir,
     )
 
     table = Table(title="Sweep results (final round)")
@@ -290,7 +468,25 @@ def _run_sweep(config, seeds, output, device):
                    "member label) here")
 @click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
               help="Force the JAX platform")
-def sweep(config_path: Path, seeds, verbose, output, device):
+@click.option("--checkpoint-dir", type=click.Path(path_type=Path), default=None,
+              help="Snapshot the FULL stacked gang state here (every "
+                   "member's lane + history — durability/snapshot.py). "
+                   "Default: durability.checkpoint_dir")
+@click.option("--checkpoint-every", type=int, default=None,
+              help="Rounds between checkpoints (with --checkpoint-dir; "
+                   "default: durability.checkpoint_every)")
+@click.option("--resume/--no-resume", default=None,
+              help="Resume the whole gang from --checkpoint-dir if a "
+                   "snapshot exists (all members continue byte-"
+                   "identically; default: durability.resume)")
+@click.option("--require-tpu", is_flag=True, default=False,
+              help="Abort loudly unless the default JAX backend is a TPU")
+@click.option("--retries", type=int, default=None,
+              help="Retry the gang dispatch on classified-transient errors, "
+                   "restoring all members from the last snapshot (requires "
+                   "--checkpoint-dir; default: durability.retries)")
+def sweep(config_path: Path, seeds, verbose, output, device, checkpoint_dir,
+          checkpoint_every, resume, require_tpu, retries):
     """Gang-batched multi-seed execution (docs/PERFORMANCE.md).
 
     Stacks the sweep's member experiments — the config's ``sweep:`` block,
@@ -315,7 +511,11 @@ def sweep(config_path: Path, seeds, verbose, output, device):
         raise click.UsageError(
             "config has no sweep block; add one or pass --seeds 1,2,3"
         )
-    return _run_sweep(config, seeds=seed_list, output=output, device=device)
+    return _run_sweep(
+        config, seeds=seed_list, output=output, device=device,
+        checkpoint_dir=checkpoint_dir, checkpoint_every=checkpoint_every,
+        resume=resume, require_tpu=require_tpu, retries=retries,
+    )
 
 
 @app.command("run-node")
@@ -366,6 +566,14 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "given (the flow pass traces the live registry, not files).",
 )
 @click.option(
+    "--durability/--no-durability", "durability", default=None,
+    help="Run the executable resume-determinism contract (MUR901/902: "
+         "save→restore→replay byte-equality and zero-recompile restore "
+         "per rule x exchange mode).  Compiles and runs tiny programs "
+         "(~2 min on CPU).  Default: on for the package check, off when "
+         "explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary records) as JSON "
          "lines for editor/CI annotation instead of the greppable text "
@@ -376,16 +584,18 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
     help="Re-measure the AOT cost grid and rewrite analysis/BUDGETS.json; "
          "review the diff as perf history.",
 )
-def check(paths, contracts, ir, flow, as_json, update_budgets):
+def check(paths, contracts, ir, flow, durability, as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
     Runs the AST lint rules (MUR001-006: traced branches, host syncs,
     recompilation hazards, import-time allocation, dtype promotion), the
     cross-layer contract checks (MUR101-103), and — for the package check —
-    the jaxpr/HLO IR contracts plus committed cost budgets (MUR200-206)
-    and the jaxpr dataflow contracts (MUR800-804: per-neighbor Byzantine
-    influence bounds, NaN/attack scrub dominance, zero-free denominators).
+    the jaxpr/HLO IR contracts plus committed cost budgets (MUR200-206),
+    the jaxpr dataflow contracts (MUR800-804: per-neighbor Byzantine
+    influence bounds, NaN/attack scrub dominance, zero-free denominators),
+    and the durability contracts (MUR900 snapshot completeness via
+    --contracts; MUR901/902 resume determinism via --durability).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -406,7 +616,8 @@ def check(paths, contracts, ir, flow, as_json, update_budgets):
     )
 
     findings, records = run_check_detailed(
-        list(paths) or None, contracts=contracts, ir=ir, flow=flow
+        list(paths) or None, contracts=contracts, ir=ir, flow=flow,
+        durability=durability,
     )
     if as_json:
         out = format_findings_json(findings, records)

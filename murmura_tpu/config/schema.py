@@ -240,6 +240,71 @@ class CompressionConfig(_Strict):
     )
 
 
+class DurabilityConfig(_Strict):
+    """Run-level durability (murmura_tpu extension; ISSUE 10 —
+    docs/ROBUSTNESS.md "Run durability").
+
+    Crash-equivalent checkpoint/resume for the jitted backends (single
+    runs, gangs, population streaming) plus the elastic dispatch
+    envelope: transient-error retries with exponential backoff and the
+    ``require_tpu`` hard-fail replacing the silent CPU fallback.  CLI
+    flags (``--checkpoint-dir``/``--resume``/``--require-tpu``/
+    ``--retries``) override these; the block makes a run's durability
+    posture part of its committed config.
+
+    Default (no checkpoint_dir, retries 0, require_tpu off) =>
+    byte-identical behavior to a config without this block.
+    """
+
+    checkpoint_dir: Optional[str] = Field(
+        default=None,
+        description=(
+            "Snapshot the complete run state here every checkpoint_every "
+            "rounds through the fsync'd durable-replace path "
+            "(durability/snapshot.py); None disables checkpointing"
+        ),
+    )
+    checkpoint_every: int = Field(
+        default=5, ge=1,
+        description="Rounds between snapshots (with checkpoint_dir)",
+    )
+    resume: bool = Field(
+        default=False,
+        description=(
+            "Resume from checkpoint_dir when a snapshot exists (the CLI "
+            "--resume twin); the telemetry event stream appends instead "
+            "of rotating, and continuation is byte-identical to the "
+            "uninterrupted run (MUR901)"
+        ),
+    )
+    require_tpu: bool = Field(
+        default=False,
+        description=(
+            "Hard-fail (BackendRequirementError) unless the default JAX "
+            "backend is a TPU — replaces the silent CPU fallback.  Env "
+            "twin: MURMURA_REQUIRE_TPU=1"
+        ),
+    )
+    retries: int = Field(
+        default=0, ge=0,
+        description=(
+            "Transient-error retries for the training dispatch: on a "
+            "classified-transient failure (device/tunnel/transport — "
+            "durability/dispatch.py) the run restores from its last "
+            "snapshot and retries with exponential backoff + jitter.  "
+            "Requires checkpoint_dir (retrying consumed/donated buffers "
+            "without a restore is never safe)"
+        ),
+    )
+    retry_base_delay_s: float = Field(
+        default=1.0, ge=0.0,
+        description="First backoff delay; doubles per retry",
+    )
+    retry_max_delay_s: float = Field(
+        default=60.0, ge=0.0, description="Backoff delay ceiling",
+    )
+
+
 class TelemetryConfig(_Strict):
     """Unified runtime telemetry (murmura_tpu extension; ISSUE 4 —
     docs/OBSERVABILITY.md).
@@ -720,6 +785,14 @@ class Config(_Strict):
             "behavior to today"
         ),
     )
+    durability: DurabilityConfig = Field(
+        default_factory=DurabilityConfig,
+        description=(
+            "Run-level durability: crash-equivalent checkpoint/resume + "
+            "retry/backoff dispatch envelope + require-tpu hard-fail; "
+            "default off => byte-identical to no durability block"
+        ),
+    )
 
     @model_validator(mode="after")
     def _telemetry_requires_enabled(self):
@@ -894,6 +967,31 @@ class Config(_Strict):
                     "(cohort swaps reassign node slots); use stateless "
                     "int8 or disable the population block"
                 )
+        return self
+
+    @model_validator(mode="after")
+    def _durability_is_wirable(self):
+        d = self.durability
+        if d.checkpoint_dir is None and (d.resume or d.retries):
+            # Same fail-loud discipline as the telemetry sub-settings: a
+            # resume/retry posture without a snapshot location would
+            # silently run non-durable while the config *looks* durable.
+            raise ValueError(
+                "durability.resume/retries require durability."
+                "checkpoint_dir (there is nothing to restore from)"
+            )
+        if d.retry_max_delay_s < d.retry_base_delay_s:
+            raise ValueError(
+                f"durability.retry_max_delay_s={d.retry_max_delay_s} < "
+                f"retry_base_delay_s={d.retry_base_delay_s}"
+            )
+        if d.checkpoint_dir is not None and self.backend == "distributed":
+            raise ValueError(
+                "durability.checkpoint_dir is not supported with "
+                "backend: distributed — run state lives in per-node "
+                "processes, which keep their own per-node fsync'd "
+                "checkpoints (faults.enabled crash recovery)"
+            )
         return self
 
     @model_validator(mode="after")

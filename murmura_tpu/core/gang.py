@@ -387,6 +387,13 @@ class GangNetwork:
         ]
         self.round_times: List[float] = []
         self.current_round = 0
+        # Graceful degradation (durability/dispatch.py; docs/ROBUSTNESS.md):
+        # a member marked dead keeps computing (its vmap lane cannot be
+        # carved out of the compiled program — the same reason padding
+        # members execute) but its history FREEZES at the failure round
+        # and its telemetry surfaces the degradation, while survivors
+        # continue unperturbed.  The alive-mask trick, one level up.
+        self.member_active: List[bool] = [True] * self.gang_size
 
     # ------------------------------------------------------------------
 
@@ -434,22 +441,30 @@ class GangNetwork:
         verbose: bool = False,
         eval_every: int = 1,
         rounds_per_dispatch: int = 1,
+        checkpoint_dir: Optional[str] = None,
+        checkpoint_every: int = 0,
     ) -> List[Dict[str, List[Any]]]:
         """Run the gang for ``rounds`` FL rounds; returns per-member
         histories (``self.histories``).
 
-        Checkpointing/resume is deliberately not wired for gangs yet: a
-        gang exists to amortize one compile over a short sweep, and the
-        member-0-only checkpoint format would silently drop S-1 members.
+        ``checkpoint_dir``/``checkpoint_every`` snapshot the FULL stacked
+        gang state — every member's params/agg_state/rng lane plus every
+        per-member history — through the same durable path single runs
+        use (durability/snapshot.py), so an interrupted sweep resumes all
+        S members byte-identically (`murmura sweep --resume`).
         """
         try:
             with self._sanitizer_scope():
                 if rounds_per_dispatch > 1:
                     self._train_fused(
-                        rounds, verbose, eval_every, rounds_per_dispatch
+                        rounds, verbose, eval_every, rounds_per_dispatch,
+                        checkpoint_dir, checkpoint_every,
                     )
                 else:
-                    self._train_rounds(rounds, verbose, eval_every)
+                    self._train_rounds(
+                        rounds, verbose, eval_every, checkpoint_dir,
+                        checkpoint_every,
+                    )
         finally:
             for s, t in enumerate(self.telemetry):
                 if t is not None:
@@ -470,7 +485,11 @@ class GangNetwork:
             args.insert(5, self._stage(alive, self._node_rows_s))
         return args
 
-    def _train_rounds(self, rounds, verbose, eval_every) -> None:
+    def _train_rounds(
+        self, rounds, verbose, eval_every, checkpoint_dir=None,
+        checkpoint_every=0,
+    ) -> None:
+        last_saved = -1
         for _ in range(rounds):
             round_idx = self.current_round
             t0 = time.perf_counter()
@@ -504,6 +523,15 @@ class GangNetwork:
             wall = time.perf_counter() - t0
             self.round_times.append(wall)
             self._emit_phase_times(round_idx, "gang_per_round", wall)
+            if (
+                checkpoint_dir
+                and checkpoint_every
+                and self.current_round % checkpoint_every == 0
+            ):
+                self.save_checkpoint(checkpoint_dir)
+                last_saved = self.current_round
+        if checkpoint_dir and rounds > 0 and self.current_round != last_saved:
+            self.save_checkpoint(checkpoint_dir)
 
     def _fused_step(self, chunk: int, eval_every: int):
         key = (chunk, eval_every)
@@ -530,7 +558,10 @@ class GangNetwork:
                 )
         return self._fused_cache[key]
 
-    def _train_fused(self, rounds, verbose, eval_every, chunk) -> None:
+    def _train_fused(
+        self, rounds, verbose, eval_every, chunk, checkpoint_dir=None,
+        checkpoint_every=0,
+    ) -> None:
         done = 0
         while done < rounds:
             k = min(chunk, rounds - done)
@@ -591,6 +622,143 @@ class GangNetwork:
                     )
             if self._tracker is not None:
                 self._tracker.end(allow=chunk_warmup)
+            crossed_cadence = checkpoint_every and (
+                self.current_round // checkpoint_every
+                > round0 // checkpoint_every
+            )
+            if checkpoint_dir and (crossed_cadence or done >= rounds):
+                self.save_checkpoint(checkpoint_dir)
+
+    # ------------------------------------------------------------------
+    # durability (durability/snapshot.py): the gang snapshots through the
+    # same fsync'd path single runs use; every section carries the full
+    # padded [B, ...] stack so a restore is value-only into the warm
+    # compiled program (padding lanes replicate member 0's trajectory
+    # exactly, so saving them costs bytes but buys bit-exactness).
+
+    def save_checkpoint(self, directory: str) -> None:
+        from murmura_tpu.durability.snapshot import save_run_snapshot
+
+        t0 = time.perf_counter()
+        save_run_snapshot(directory, self)
+        for t in self.telemetry:
+            if t is not None:
+                t.checkpoint_event(
+                    self.current_round, time.perf_counter() - t0,
+                    action="save", path=str(directory),
+                )
+
+    def restore_checkpoint(self, directory: str) -> int:
+        """Restore the full gang; returns the round to continue from."""
+        from murmura_tpu.durability.snapshot import restore_run_snapshot
+
+        t0 = time.perf_counter()
+        round_num = restore_run_snapshot(directory, self)
+        for t in self.telemetry:
+            if t is not None:
+                t.checkpoint_event(
+                    round_num, time.perf_counter() - t0,
+                    action="restore", path=str(directory),
+                )
+                t.emit(
+                    "run_resumed", round=round_num, path=str(directory),
+                    run_id=t.run_id,
+                )
+        return round_num
+
+    def _durability_history(self):
+        return {
+            "gang_members": self.histories,
+            "labels": [m.label for m in self.members],
+        }
+
+    def _durability_set_history(self, history) -> None:
+        if not isinstance(history, dict) or "gang_members" not in history:
+            raise ValueError(
+                "snapshot carries no gang history — it was written by a "
+                "single run; resume it with `murmura run --resume` instead"
+            )
+        labels = history.get("labels")
+        ours = [m.label for m in self.members]
+        if labels != ours:
+            raise ValueError(
+                f"gang snapshot members {labels} != this gang's {ours} — "
+                "resuming into a different member set would misattribute "
+                "every lane; rebuild with the sweep that wrote the snapshot"
+            )
+        self.histories = history["gang_members"]
+
+    def _durability_extra_state(self):
+        meta: Dict[str, Any] = {
+            "gang": {
+                "batch": self.batch,
+                "gang_size": self.gang_size,
+                "member_active": list(self.member_active),
+                # Duplicated from the history payload so the member-set
+                # identity check can run PRE-mutation (validate hook).
+                "labels": [m.label for m in self.members],
+            }
+        }
+        run_ids = [
+            t.run_id if t is not None else None for t in self.telemetry
+        ]
+        if any(r is not None for r in run_ids):
+            meta["telemetry_run_ids"] = run_ids
+        return {}, meta
+
+    def _durability_validate_extra(self, arrays, meta) -> None:
+        gm = meta.get("gang")
+        if gm is None:
+            raise ValueError(
+                "snapshot carries no gang section — it was written by a "
+                "single run; resume it with `murmura run --resume` instead"
+            )
+        if int(gm["batch"]) != self.batch:
+            raise ValueError(
+                f"gang snapshot batch {gm['batch']} != this gang's "
+                f"{self.batch} — the stacked state shapes cannot match"
+            )
+        labels = gm.get("labels")
+        ours = [m.label for m in self.members]
+        if labels is not None and labels != ours:
+            # Same member count/batch but a different seed list has
+            # identical stacked shapes — the shape guard cannot catch it,
+            # and this must refuse BEFORE any lane is overwritten.
+            raise ValueError(
+                f"gang snapshot members {labels} != this gang's {ours} — "
+                "resuming into a different member set would misattribute "
+                "every lane; rebuild with the sweep that wrote the snapshot"
+            )
+
+    def _durability_restore_extra(self, arrays, meta) -> None:
+        gm = meta["gang"]
+        active = gm.get("member_active")
+        if active is not None and len(active) == self.gang_size:
+            self.member_active = [bool(a) for a in active]
+
+    def freeze_member(self, member: int, reason: str) -> None:
+        """Gracefully degrade one member's lane: recording stops (its
+        history freezes at the current round), survivors continue, and
+        the degradation is surfaced as a ``backend_degraded`` telemetry
+        event.  The lane's compute continues — a vmap lane cannot be
+        carved out of the compiled program, exactly like the padding
+        members — so freezing never perturbs the surviving members'
+        numbers.  Idempotent."""
+        if not 0 <= member < self.gang_size:
+            raise ValueError(
+                f"member {member} out of range for gang of {self.gang_size}"
+            )
+        if not self.member_active[member]:
+            return
+        self.member_active[member] = False
+        t = self.telemetry[member] if self.telemetry else None
+        if t is not None:
+            t.emit(
+                "backend_degraded",
+                member=self.members[member].label,
+                reason=reason,
+                round=self.current_round,
+            )
 
     # ------------------------------------------------------------------
 
@@ -614,6 +782,11 @@ class GangNetwork:
                 self._adjacency_for_round(round_num - 1)
             ).sum(axis=0)
         for s in range(self.gang_size):
+            if not self.member_active[s]:
+                # Frozen lane (freeze_member): the member's history stays
+                # at its failure round; its compute still ran (vmap lane),
+                # like a padding member's.
+                continue
             member_metrics = {
                 k: np.asarray(v)[s] for k, v in metrics.items()
             }

@@ -801,19 +801,13 @@ class Network:
         pending.clear()
 
     def save_checkpoint(self, directory: str) -> None:
-        """Snapshot run state to ``directory`` (see utils/checkpoint.py)."""
-        from murmura_tpu.utils.checkpoint import save_checkpoint
+        """Snapshot the complete run state to ``directory``
+        (durability/snapshot.py over the fsync'd utils/checkpoint.py
+        path)."""
+        from murmura_tpu.durability.snapshot import save_run_snapshot
 
         t0 = time.perf_counter()
-        save_checkpoint(
-            directory,
-            params=self.params,
-            agg_state=self.agg_state,
-            rng=self._rng,
-            round_num=self.current_round,
-            history=self.history,
-            round_times=self.round_times,
-        )
+        save_run_snapshot(directory, self)
         if self.telemetry is not None:
             self.telemetry.checkpoint_event(
                 self.current_round, time.perf_counter() - t0,
@@ -821,29 +815,68 @@ class Network:
             )
 
     def restore_checkpoint(self, directory: str) -> int:
-        """Restore run state; returns the round to continue from."""
-        from murmura_tpu.utils.checkpoint import restore_checkpoint
+        """Restore run state; returns the round to continue from.
+
+        Value-only into the (possibly warm) compiled program — zero extra
+        compiles, donation-safe (restored buffers are fresh).  Emits a
+        ``run_resumed`` telemetry event so a resumed run is visible in
+        the event stream it APPENDS to (the writer must have been opened
+        with ``resume=True`` — factories.build_network_from_config does
+        this automatically when a checkpoint exists).
+        """
+        from murmura_tpu.durability.snapshot import restore_run_snapshot
 
         t0 = time.perf_counter()
-        params, agg_state, rng, round_num, history, times = restore_checkpoint(
-            directory,
-            params_target=self.params,
-            agg_state_target=self.agg_state,
-            rng_target=self._rng,
-        )
-        self.params = jax.tree_util.tree_map(jnp.asarray, params)
-        self.agg_state = {k: jnp.asarray(v) for k, v in agg_state.items()}
-        self._place_resident_state()
-        self._rng = jnp.asarray(rng)
-        self.current_round = round_num
-        self.history = history
-        self.round_times = times
+        round_num = restore_run_snapshot(directory, self)
         if self.telemetry is not None:
             self.telemetry.checkpoint_event(
                 round_num, time.perf_counter() - t0,
                 action="restore", path=str(directory),
             )
+            self.telemetry.emit(
+                "run_resumed", round=round_num, path=str(directory),
+                run_id=self.telemetry.run_id,
+            )
         return round_num
+
+    # ------------------------------------------------------------------
+    # durability hooks (durability/snapshot.py): what a complete snapshot
+    # of THIS orchestrator carries beyond the base sections.  Subclasses
+    # (PopulationNetwork, and the gang twin in core/gang.py) override.
+
+    def _durability_history(self):
+        """The json-able history section of a snapshot."""
+        return self.history
+
+    def _durability_set_history(self, history) -> None:
+        self.history = history
+
+    def _durability_extra_state(self):
+        """(arrays, meta) extra sections; the base orchestrator carries
+        only the telemetry run id (stable across resumes — writer.py)."""
+        meta = {}
+        if self.telemetry is not None:
+            meta["telemetry_run_id"] = self.telemetry.run_id
+        return {}, meta
+
+    def _durability_validate_extra(self, arrays, meta) -> None:
+        """Pure pre-restore validation, called BEFORE any live state is
+        mutated — raise to refuse the snapshot.  A gang snapshot carries
+        its member data in extra_meta with NO extra arrays, and flax's
+        from_bytes would happily load its [S, ...]-stacked leaves into a
+        single run — so refuse on meta keys too, symmetric with the
+        gang/population guards."""
+        foreign = sorted(set(arrays) | ({"gang", "population"} & set(meta)))
+        if foreign:
+            raise ValueError(
+                f"snapshot carries extra sections {foreign} this "
+                "orchestrator does not understand — it was written by a "
+                "population/gang run; rebuild with the matching config"
+            )
+
+    def _durability_restore_extra(self, arrays, meta) -> None:
+        """Apply orchestrator-specific sections after the base restore;
+        validation already happened in ``_durability_validate_extra``."""
 
     def _record(self, round_num: int, metrics: Dict[str, np.ndarray], verbose: bool):
         acc = np.asarray(metrics["accuracy"])

@@ -1,0 +1,43 @@
+"""Run-level durability: crash-equivalent checkpoint/resume + the elastic
+dispatch envelope (docs/ROBUSTNESS.md "Run durability").
+
+Two halves:
+
+- :mod:`murmura_tpu.durability.snapshot` — the versioned run-state
+  snapshot every in-jit orchestrator (Network, PopulationNetwork,
+  GangNetwork) saves and restores through, written via the fsync'd
+  ``utils.checkpoint.durable_replace`` path.  The reserved carried-state
+  key registry lives here too; `murmura check` rule MUR900 keeps it in
+  bijection with every ``*_STATE_KEYS`` tuple in the package.
+- :mod:`murmura_tpu.durability.dispatch` — transient-error
+  classification, exponential-backoff-with-jitter retry, and the
+  ``--require-tpu`` hard-fail replacing the silent CPU fallback.
+"""
+
+from murmura_tpu.durability.dispatch import (
+    BackendRequirementError,
+    RetryPolicy,
+    classify_error,
+    require_tpu,
+    run_with_retry,
+    tpu_required,
+)
+from murmura_tpu.durability.snapshot import (
+    RESERVED_AGG_STATE_KEY_GROUPS,
+    SNAPSHOT_BASE_SECTIONS,
+    restore_run_snapshot,
+    save_run_snapshot,
+)
+
+__all__ = [
+    "BackendRequirementError",
+    "RetryPolicy",
+    "classify_error",
+    "require_tpu",
+    "run_with_retry",
+    "tpu_required",
+    "RESERVED_AGG_STATE_KEY_GROUPS",
+    "SNAPSHOT_BASE_SECTIONS",
+    "restore_run_snapshot",
+    "save_run_snapshot",
+]

@@ -1,0 +1,287 @@
+"""The unified run-state snapshot: one schema for every in-jit orchestrator.
+
+PR 3 gave the ZMQ backend real SIGKILL recovery, but the jitted
+simulation/tpu backends — and everything layered on them since (gang
+sweeps, compression's carried EF residual / topk reference, the
+population engine) — lost the whole run on any interruption.  This module
+is the one place that knows what "the whole run" is:
+
+- the **base sections** every orchestrator carries
+  (:data:`SNAPSHOT_BASE_SECTIONS`): stacked params, the FULL ``agg_state``
+  dict (which is where every reserved carried-state key group lives —
+  compression's EF residual and topk reference, DMTT trust state), the
+  RNG base key, the round counter, history, and round times;
+- orchestrator-specific **extra sections** collected through the
+  ``_durability_extra_state()`` / ``_durability_restore_extra()`` hooks:
+  the population engine's cohort binding + sampler draw index + state
+  bank, the gang's per-member histories/labels, the telemetry run id.
+
+Crash-equivalence is provable rather than aspirational because every
+random stream in the framework is already a pure function of
+``(seed, round)``: the round key is ``fold_in(base, round)``, the
+FaultSchedule and MobilityModel regenerate from their seeds, and cohort
+draws are keyed by ``(seed, draw_idx)``.  So the snapshot only needs the
+*carried* state — everything else reconstructs deterministically — and a
+restore into the warm compiled program is value-only: zero recompiles
+(MUR902), byte-identical continuation (MUR901, tests/test_durability.py).
+
+Storage rides :mod:`murmura_tpu.utils.checkpoint` — the fsync'd
+``durable_replace`` path shared with the ZMQ per-node checkpoints and the
+telemetry manifest, so there is ONE durability story in the repo, not
+three.
+
+The reserved carried-state key registry
+---------------------------------------
+
+Subsystems that carry state across rounds inside ``agg_state`` reserve
+their keys in a module-level ``*_STATE_KEYS`` tuple (``ops/compress.py``
+COMPRESS_STATE_KEYS, ``core/rounds.py`` DMTT_STATE_KEYS).  Because the
+snapshot saves ``agg_state`` whole, those keys are durable *today* — the
+risk is tomorrow: a future "save only the cheap keys" optimization, or a
+new subsystem whose reserved tuple never gets audited.
+:data:`RESERVED_AGG_STATE_KEY_GROUPS` is the registry `murmura check`
+rule MUR900 (analysis/contracts.py) keeps honest, two ways:
+
+1. every module-level ``*_STATE_KEYS`` assignment discovered in the
+   package source must be registered here (and resolve to a tuple of
+   strings) — an unregistered reserved group is a finding;
+2. a payload containing every reserved key must survive the
+   save→restore roundtrip byte-for-byte (executed, negative-tested).
+"""
+
+import ast
+from pathlib import Path
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+# Sections every snapshot carries, regardless of orchestrator.  The
+# MUR900 completeness contract asserts a snapshot roundtrip preserves
+# each of them; the names double as the payload keys in the
+# state.<round>.msgpack / meta.json pair (utils/checkpoint.py).
+SNAPSHOT_BASE_SECTIONS: Tuple[str, ...] = (
+    "params",       # stacked [N, ...] model pytree (optimizer state is
+                    # SGD-free today; a stateful optimizer's slots would
+                    # ride params or agg_state and be covered either way)
+    "agg_state",    # FULL carried aggregation state, reserved keys included
+    "rng",          # the base PRNG key (round keys are fold_in(base, r))
+    "round",        # the persistent round counter
+    "history",      # recorded metrics (the run's output so far)
+    "round_times",  # per-round wall times
+)
+
+# Registry of every reserved carried-state key-group tuple in the
+# package: group name -> defining module.  MUR900 discovers
+# ``*_STATE_KEYS`` assignments by AST scan and fails the check when one
+# is missing here (or when an entry here no longer resolves).
+RESERVED_AGG_STATE_KEY_GROUPS: Dict[str, str] = {
+    "COMPRESS_STATE_KEYS": "murmura_tpu.ops.compress",
+    "DMTT_STATE_KEYS": "murmura_tpu.core.rounds",
+}
+
+
+def resolve_reserved_agg_state_keys() -> Dict[str, Tuple[str, ...]]:
+    """Import every registered group; raises if an entry is stale."""
+    import importlib
+
+    out: Dict[str, Tuple[str, ...]] = {}
+    for group, module in RESERVED_AGG_STATE_KEY_GROUPS.items():
+        mod = importlib.import_module(module)
+        keys = getattr(mod, group)
+        if not (
+            isinstance(keys, tuple)
+            and keys
+            and all(isinstance(k, str) for k in keys)
+        ):
+            raise TypeError(
+                f"{module}.{group} must be a non-empty tuple of str "
+                f"agg_state keys, got {keys!r}"
+            )
+        out[group] = keys
+    return out
+
+
+def discover_state_key_groups(pkg_root) -> Dict[str, str]:
+    """AST-scan the package for module-level ``*_STATE_KEYS`` tuple
+    assignments — the discovery half of the MUR900 bijection.  Returns
+    ``{group_name: module_dotted_path}``."""
+    pkg_root = Path(pkg_root)
+    found: Dict[str, str] = {}
+    for py in sorted(pkg_root.rglob("*.py")):
+        try:
+            tree = ast.parse(py.read_text())
+        except (OSError, SyntaxError):
+            continue  # unreadable files are MUR000 findings in lint
+        rel = py.relative_to(pkg_root.parent).with_suffix("")
+        module = ".".join(rel.parts)
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [
+                    t.id for t in node.targets if isinstance(t, ast.Name)
+                ]
+            elif isinstance(node, ast.AnnAssign) and isinstance(
+                node.target, ast.Name
+            ):
+                targets = [node.target.id]
+            for name in targets:
+                if name.endswith("_STATE_KEYS"):
+                    found[name] = module
+    return found
+
+
+# ----------------------------------------------------------------------
+# save / restore
+
+
+def save_run_snapshot(directory, network) -> None:
+    """Write ``network``'s complete run state to ``directory``.
+
+    Collects the base sections from the orchestrator plus its
+    ``_durability_extra_state()`` sections, and writes them through the
+    fsync'd checkpoint path (utils/checkpoint.py): a crash at ANY point
+    leaves either the previous complete snapshot or the new one.
+    """
+    from murmura_tpu.utils.checkpoint import save_checkpoint
+
+    extra_arrays, extra_meta = network._durability_extra_state()
+    save_checkpoint(
+        directory,
+        params=network.params,
+        agg_state=network.agg_state,
+        rng=network._rng,
+        round_num=network.current_round,
+        history=network._durability_history(),
+        round_times=network.round_times,
+        extra_arrays=extra_arrays,
+        extra_meta=extra_meta,
+    )
+
+
+def restore_run_snapshot(directory, network) -> int:
+    """Restore ``network`` from ``directory``; returns the round to
+    continue from.
+
+    The restore is value-only: the arrays land with the shapes/dtypes the
+    warm compiled program already specialized on and are re-placed on the
+    mesh (``_place_resident_state``), so continuing costs zero extra
+    compiles (MUR902) and a resumed history is byte-identical to the
+    uninterrupted run (MUR901).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from murmura_tpu.utils.checkpoint import restore_checkpoint
+
+    (params, agg_state, rng, round_num, history, times,
+     extra_arrays, extra_meta) = restore_checkpoint(
+        directory,
+        params_target=network.params,
+        agg_state_target=network.agg_state,
+        rng_target=network._rng,
+    )
+    # Refuse BEFORE mutating any live state: first the orchestrator's own
+    # pure validation (kind/config identity — the specific messages), then
+    # the generic shape guard (flax's from_bytes restores leaves at their
+    # SAVED shapes without validating them against the target, so a
+    # foreign snapshot would otherwise land silently and crash opaquely
+    # later).
+    network._durability_validate_extra(extra_arrays, extra_meta)
+    saved = [np.shape(x) for x in jax.tree_util.tree_leaves(params)]
+    live = [np.shape(x) for x in jax.tree_util.tree_leaves(network.params)]
+    if saved != live:
+        raise ValueError(
+            f"snapshot params shapes {saved} do not match this run's "
+            f"compiled shapes {live} — the snapshot was written by a "
+            "different orchestrator (a single run vs a gang's "
+            "[S, ...]-stacked lanes) or a different config; rebuild with "
+            "the matching config"
+        )
+    network.params = jax.tree_util.tree_map(jnp.asarray, params)
+    network.agg_state = {k: jnp.asarray(v) for k, v in agg_state.items()}
+    network._place_resident_state()
+    network._rng = jnp.asarray(rng)
+    network.current_round = round_num
+    network._durability_set_history(history)
+    network.round_times = times
+    network._durability_restore_extra(extra_arrays, extra_meta)
+    return round_num
+
+
+def embed_bool_mask(mask: np.ndarray) -> np.ndarray:
+    """Pack a boolean mask for the snapshot (8x smaller; a 1M-user
+    activation mask costs ~125 KB)."""
+    return np.packbits(np.asarray(mask, dtype=bool))
+
+
+def unpack_bool_mask(packed: np.ndarray, size: int) -> np.ndarray:
+    return np.unpackbits(np.asarray(packed, dtype=np.uint8))[:size].astype(bool)
+
+
+# ----------------------------------------------------------------------
+# MUR900 executable completeness probe (used by analysis/contracts.py and
+# negative-tested in tests/test_durability.py)
+
+
+def snapshot_roundtrip_missing_sections(
+    directory, payload_sections: Dict[str, Any]
+) -> Tuple[list, list]:
+    """Write a synthetic snapshot from ``payload_sections`` (a dict with
+    the base-section names) into ``directory``, read it back, and return
+    ``(missing_sections, corrupted_agg_keys)``.
+
+    This is the executable half of MUR900: the registry says what a
+    complete snapshot must carry; this function proves the serialization
+    path actually carries it.  Callers (analysis/contracts.py) populate
+    ``agg_state`` with every reserved key; a key that does not survive
+    byte-for-byte is returned in ``corrupted_agg_keys``.
+    """
+    from murmura_tpu.utils.checkpoint import (
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    missing = [s for s in SNAPSHOT_BASE_SECTIONS if s not in payload_sections]
+    if missing:
+        return missing, []
+    save_checkpoint(
+        directory,
+        params=payload_sections["params"],
+        agg_state=payload_sections["agg_state"],
+        rng=payload_sections["rng"],
+        round_num=payload_sections["round"],
+        history=payload_sections["history"],
+        round_times=payload_sections["round_times"],
+    )
+    params, agg_state, rng, round_num, history, times, _, _ = (
+        restore_checkpoint(
+            directory,
+            params_target=payload_sections["params"],
+            agg_state_target=payload_sections["agg_state"],
+            rng_target=payload_sections["rng"],
+        )
+    )
+    restored = {
+        "params": params, "agg_state": agg_state, "rng": rng,
+        "round": round_num, "history": history, "round_times": times,
+    }
+    missing = [
+        s for s in SNAPSHOT_BASE_SECTIONS
+        if restored.get(s) is None and payload_sections[s] is not None
+    ]
+    corrupted = [
+        k for k, v in payload_sections["agg_state"].items()
+        if k not in agg_state
+        or not np.array_equal(
+            np.asarray(agg_state[k]), np.asarray(v), equal_nan=True
+        )
+    ]
+    return missing, corrupted
+
+
+# Re-exported for existing importers; the .npz container helpers live
+# with the file format they serialize (utils/checkpoint.py).
+from murmura_tpu.utils.checkpoint import (  # noqa: E402,F401
+    load_npz_bytes,
+    npz_bytes,
+)

@@ -56,6 +56,9 @@ class PopulationBank:
         self.dtype = np.dtype(dtype)
         nbytes = self.virtual_size * self.row_dim * self.dtype.itemsize
         self._tmpdir = None
+        # Whether an existing backing file was adopted instead of created
+        # — the durability resume path requires this for external banks.
+        self.reattached = False
         if directory is None and nbytes <= _IN_MEMORY_BYTES:
             self.path = None
             self._rows = np.zeros(
@@ -69,10 +72,37 @@ class PopulationBank:
                 directory = self._tmpdir.name
             os.makedirs(directory, exist_ok=True)
             self.path = os.path.join(directory, "bank.dat")
+            # A pre-existing file of the right size is REATTACHED ("r+")
+            # instead of truncated — the durability resume path
+            # (durability/snapshot.py) re-opens a flushed bank in place.
+            # Stale rows in a reused directory are harmless: nothing reads
+            # a row until its user is marked in ``_has_row``, which starts
+            # all-False and is restored separately on resume.
+            nominal = self.virtual_size * self.row_dim * self.dtype.itemsize
+            existing = (
+                os.path.getsize(self.path)
+                if os.path.exists(self.path) else None
+            )
+            if existing is not None and existing != nominal:
+                # mode="w+" would ftruncate a file that may be the flushed
+                # row data of a live snapshot (durability/snapshot.py
+                # "external" mode) — a config whose virtual_size/model
+                # changed must refuse BEFORE destroying it, not after a
+                # restore-time validation that would come too late.
+                raise ValueError(
+                    f"population bank {self.path} holds {existing} bytes "
+                    f"but this config needs {nominal} "
+                    f"({self.virtual_size} users x {self.row_dim} f32) — "
+                    "refusing to truncate an existing bank; point "
+                    "population.bank_dir at a clean directory or restore "
+                    "the matching config"
+                )
+            reattach = existing is not None
+            self.reattached = reattach
             # mode="w+" ftruncates to the nominal size; the file is sparse,
             # so disk/page-cache cost follows *touched* rows, not U x P.
             self._rows = np.memmap(
-                self.path, dtype=self.dtype, mode="w+",
+                self.path, dtype=self.dtype, mode="r+" if reattach else "w+",
                 shape=(self.virtual_size, self.row_dim),
             )
         # Which users have a persistent row (first write-back sets it).
@@ -110,6 +140,28 @@ class PopulationBank:
     def rows_of(self, users: np.ndarray) -> np.ndarray:
         """Raw rows (no default fallback) — test/inspection helper."""
         return np.array(self._rows[np.asarray(users, dtype=np.int64)])
+
+    @property
+    def activated_users(self) -> np.ndarray:
+        """[activated] int64 ids of users with a persistent row."""
+        return np.flatnonzero(self._has_row).astype(np.int64)
+
+    def flush(self) -> None:
+        """Push dirty pages to the backing file (memmap-backed banks;
+        no-op in RAM) — the cheap half of a snapshot: the rows stay in
+        place, only the activation mask rides the snapshot payload."""
+        if self.path is not None:
+            self._rows.flush()
+
+    def restore_activation(self, has_row: np.ndarray) -> None:
+        """Adopt a restored activation mask (durability resume)."""
+        has_row = np.asarray(has_row, dtype=bool)
+        if has_row.shape != (self.virtual_size,):
+            raise ValueError(
+                f"activation mask shape {has_row.shape} != "
+                f"({self.virtual_size},)"
+            )
+        self._has_row = has_row.copy()
 
     def close(self) -> None:
         if self._tmpdir is not None:

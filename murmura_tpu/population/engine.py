@@ -91,6 +91,10 @@ class PopulationNetwork(Network):
             population.virtual_size, self._flat_dim,
             dtype=np.float32, directory=population.bank_dir,
         )
+        # Set the first time THIS instance flushes the bank into a
+        # snapshot — the in-place-restore credential the validate hook
+        # checks (a fresh process must instead reattach the flushed file).
+        self._bank_flushed_here = False
         # Teleport composition (docs/SCALING.md): banked users resume
         # their own row, fresh users adopt the outgoing cohort's trained
         # slot row — composed ON DEVICE so the prefetched H2D copies stay
@@ -224,17 +228,17 @@ class PopulationNetwork(Network):
     ):
         """Cohort-streaming round loop (per-round dispatch).
 
-        ``checkpoint_dir`` is rejected: run state spans the bank plus the
-        resident cohort, which the Network checkpoint schema does not
-        cover yet.  ``rounds_per_dispatch > 1`` falls back to per-round
-        dispatch with a warning — a fused scan would pin one cohort for
-        the whole chunk.
+        ``checkpoint_dir``/``checkpoint_every`` snapshot the COMPLETE
+        streaming state (durability/snapshot.py): the base sections plus
+        the resident cohort's slot↔user binding, the sampler position
+        (derivable from the round — draws are pure in ``(seed,
+        draw_idx)``), and the state bank (memmap flushed in place when
+        ``population.bank_dir`` is set, activated rows embedded in the
+        snapshot otherwise) — a resumed 100k-virtual-user run continues
+        across cohort swaps with zero extra recompiles.
+        ``rounds_per_dispatch > 1`` falls back to per-round dispatch with
+        a warning — a fused scan would pin one cohort for the whole chunk.
         """
-        if checkpoint_dir is not None:
-            raise ValueError(
-                "population runs do not support checkpointing yet (run "
-                "state spans the host-side bank plus the resident cohort)"
-            )
         if rounds_per_dispatch > 1 or defer_metrics:
             import warnings
 
@@ -249,7 +253,10 @@ class PopulationNetwork(Network):
             jax.profiler.start_trace(self.profile_dir)
         try:
             with self._sanitizer_scope():
-                self._train_population(rounds, verbose, eval_every)
+                self._train_population(
+                    rounds, verbose, eval_every, checkpoint_dir,
+                    checkpoint_every,
+                )
         finally:
             if profile:
                 jax.profiler.stop_trace()
@@ -258,9 +265,13 @@ class PopulationNetwork(Network):
                 self.telemetry.finalize(history=self.history)
         return self.history
 
-    def _train_population(self, rounds, verbose, eval_every) -> None:
+    def _train_population(
+        self, rounds, verbose, eval_every, checkpoint_dir=None,
+        checkpoint_every=0,
+    ) -> None:
         comp = self._stage(self.compromised, self._node_s)
         rpc = self.population.rounds_per_cohort
+        last_saved = -1
         for step_i in range(rounds):
             round_idx = self.current_round
             if round_idx % rpc == 0 or self.cohort is None:
@@ -319,6 +330,17 @@ class PopulationNetwork(Network):
                 )
                 self.telemetry.memory_event(round_idx)
                 self._profile_window_stop(self.current_round)
+            if (
+                checkpoint_dir
+                and checkpoint_every
+                and self.current_round % checkpoint_every == 0
+            ):
+                # Crash-equivalent cadence snapshot: the bank is saved
+                # AS-IS (no write-back of the resident cohort — those
+                # rows ride the params section), so the restored bank is
+                # byte-identical to the uninterrupted run's at this round.
+                self.save_checkpoint(checkpoint_dir)
+                last_saved = self.current_round
         # Final write-back so the bank holds every trained row when
         # train() returns (the resident cohort stays loaded for a
         # subsequent train() call).
@@ -327,3 +349,130 @@ class PopulationNetwork(Network):
             self.bank.scatter(
                 self.cohort, np.asarray(out_flat, dtype=np.float32)
             )
+        if checkpoint_dir and rounds > 0 and self.current_round != last_saved:
+            self.save_checkpoint(checkpoint_dir)
+
+    # ------------------------------------------------------------------
+    # durability hooks (durability/snapshot.py)
+
+    def _durability_extra_state(self):
+        """The streaming state beyond the base sections: the resident
+        cohort's slot↔user binding, the swap counter, and the bank.
+
+        Bank modes: ``external`` (``population.bank_dir`` set — the
+        memmap is flushed in place and only the packed activation mask
+        rides the snapshot; O(U/8) bytes) or ``embedded`` (RAM/tempdir
+        banks whose backing dies with the process — activated ids + rows
+        are copied into the snapshot).  The sampler needs NO saved state:
+        draws are a pure function of ``(population.seed, draw_idx)`` and
+        ``draw_idx`` is ``round // rounds_per_cohort`` (sampler.py).
+        """
+        from murmura_tpu.durability.snapshot import embed_bool_mask
+
+        arrays, meta = super()._durability_extra_state()
+        p = self.population
+        external = p.bank_dir is not None
+        if external:
+            self.bank.flush()
+            self._bank_flushed_here = True
+        else:
+            ids = self.bank.activated_users
+            arrays["population/bank_user_ids"] = ids
+            arrays["population/bank_rows"] = self.bank.rows_of(ids)
+        arrays["population/bank_has_row"] = embed_bool_mask(
+            self.bank._has_row
+        )
+        if self.cohort is not None:
+            arrays["population/cohort"] = np.asarray(self.cohort, np.int64)
+        meta["population"] = {
+            "virtual_size": p.virtual_size,
+            "sampler": p.sampler,
+            "seed": p.seed,
+            "rounds_per_cohort": p.rounds_per_cohort,
+            "data_binding": p.data_binding,
+            "inherit": p.inherit,
+            "cohorts_seen": self.cohorts_seen,
+            "bank_mode": "external" if external else "embedded",
+            "bank_path": self.bank.path,
+            "activated": self.bank.activated,
+        }
+        return arrays, meta
+
+    def _durability_validate_extra(self, arrays, meta) -> None:
+        pm = meta.get("population")
+        if pm is None or "population/bank_has_row" not in arrays:
+            raise ValueError(
+                "snapshot carries no population section — it was written "
+                "by a plain run; drop the population block or point "
+                "--checkpoint-dir at a population snapshot"
+            )
+        p = self.population
+        mismatched = {
+            k: (pm.get(k), getattr(p, k))
+            for k in ("virtual_size", "sampler", "seed", "rounds_per_cohort",
+                      "data_binding", "inherit")
+            if pm.get(k) != getattr(p, k)
+        }
+        if mismatched:
+            raise ValueError(
+                "population snapshot/config mismatch (snapshot vs config): "
+                f"{mismatched} — the cohort stream would silently diverge "
+                "from the interrupted run"
+            )
+        if pm["bank_mode"] == "external":
+            # The flushed file IS the snapshot's row data, so identity
+            # matters twice over.  (a) It must be the SAME file the
+            # snapshot recorded: a reattached bank of the right size
+            # under a different bank_dir is some other experiment's rows
+            # and would silently diverge the continued history (MUR901).
+            if self.bank.path != pm["bank_path"]:
+                raise ValueError(
+                    f"population snapshot records its memmapped bank at "
+                    f"{pm['bank_path']!r} but this config's bank_dir="
+                    f"{p.bank_dir!r} opens {self.bank.path!r} — resuming "
+                    "onto a different bank file would continue from some "
+                    "other run's rows; keep the bank at the path the "
+                    "snapshot recorded"
+                )
+            # (b) The live memmap must actually BE that file's data:
+            # reattached = a fresh process adopted the flushed file;
+            # flushed here = the SAME instance that wrote the snapshot is
+            # restoring in place (the CLI retry envelope).  Path equality
+            # alone is NOT enough — a fresh build whose bank file
+            # vanished recreates an empty file at the same path.
+            if not (self.bank.reattached or self._bank_flushed_here):
+                raise ValueError(
+                    f"population snapshot expects the memmapped bank at "
+                    f"{pm['bank_path']!r} but no matching bank file was "
+                    f"found under bank_dir={p.bank_dir!r} — the flushed "
+                    "rows are the snapshot's data; restore them first"
+                )
+
+    def _durability_restore_extra(self, arrays, meta) -> None:
+        from murmura_tpu.durability.snapshot import unpack_bool_mask
+
+        pm = meta["population"]
+        p = self.population
+        # An external memmap bank is already reattached in place
+        # (validated pre-restore); an embedded bank's rows ride the
+        # snapshot and are scattered back here.
+        if pm["bank_mode"] != "external":
+            ids = arrays["population/bank_user_ids"]
+            if len(ids):
+                self.bank.scatter(ids, arrays["population/bank_rows"])
+        self.bank.restore_activation(
+            unpack_bool_mask(
+                arrays["population/bank_has_row"], p.virtual_size
+            )
+        )
+        self.cohorts_seen = int(pm["cohorts_seen"])
+        self._prefetched = None
+        cohort = arrays.get("population/cohort")
+        self.cohort = (
+            np.asarray(cohort, np.int64) if cohort is not None else None
+        )
+        if self.cohort is not None and p.data_binding == "user":
+            # Re-bind each slot's data shard to its restored user — the
+            # restored params are the resident cohort's rows and must
+            # train on the same shards they did before the interruption.
+            self._rebind_data(self.cohort)

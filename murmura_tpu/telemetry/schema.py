@@ -33,6 +33,17 @@ type            meaning
 ``memory``      per-round device ``memory_stats()`` sample
 ``checkpoint``  checkpoint write (``duration_s``) or restore
 ``profile``     profiler trace window started/stopped (``trace_dir``)
+``run_resumed`` a durability restore continued this run from a snapshot
+                (``round``, ``path``, ``run_id``) — the event stream it
+                appends to is the SAME stream the interrupted run wrote
+                (durability/snapshot.py; a resumed run never rotates its
+                own events to ``*.prev``)
+``backend_degraded``
+                the dispatch envelope observed a degradation: a
+                transient device/tunnel failure being retried with
+                backoff (``reason``, ``retry``, ``delay_s``), a bench
+                CPU fallback, or a frozen gang member lane
+                (``member``, ``reason`` — core/gang.py freeze_member)
 ``counter``     distributed-backend node counters folded by the Monitor
                 (reconnects, send retries/failures, skipped frames,
                 checkpoint durations)

@@ -5,31 +5,104 @@ memory and history is returned at the end of ``train()`` (SURVEY §5;
 reference: murmura/core/network.py:60-94).  Here the whole run state is a
 handful of device arrays (stacked params pytree, aggregator state dict, RNG
 key) plus host-side history, so a checkpoint is one msgpack blob + one JSON
-sidecar:
+commit record:
 
-    <dir>/state.msgpack   flax.serialization bytes of {params, agg_state, rng}
-    <dir>/meta.json       {round, history, round_times, version}
+    <dir>/state.<round>.msgpack  flax.serialization bytes of
+                                 {params, agg_state, rng, round}
+    <dir>/extra.<round>.npz      orchestrator extra sections (optional)
+    <dir>/meta.json              {round, history, round_times, version, ...}
 
-Restore is exact: resuming reproduces the same arrays the run would have had
-at that round boundary.
+``meta.json`` is the single COMMIT POINT: the generation-suffixed state
+and extra files are written (fsync'd) first, the meta replace publishes
+them, and only after that commit are older generations garbage-collected.
+A crash at ANY point therefore leaves a complete restorable snapshot —
+either the previous one (meta still names it, its files untouched) or the
+new one — never a torn pair.  Restore is exact: resuming reproduces the
+same arrays the run would have had at that round boundary.
 """
 
+import io
 import json
 import os
 from pathlib import Path
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import numpy as np
 from flax import serialization
 
+
+def npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    """Serialize ``arrays`` to .npz bytes (the extra-section container)."""
+    buf = io.BytesIO()
+    np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+    return buf.getvalue()
+
+
+def load_npz_bytes(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        return {k: np.array(z[k]) for k in z.files}
+
 # v3: per-round step keys changed from an advancing split() chain to
 # fold_in(base, round) — the saved rng blob is now the static base key, not
 # chain state.  A v2 checkpoint restored into a v3 build would resume with a
 # silently different noise/SGD stream, so the version gate fails it loudly.
+# v3 also covers the durability extension (extra sections below): the core
+# pair is unchanged, a v3 checkpoint without sections restores as before.
 CKPT_VERSION = 3
-STATE_FILE = "state.msgpack"
 META_FILE = "meta.json"
+# Generation-suffixed payload files, committed by the meta.json replace.
+# The legacy un-suffixed names are still READ (a pre-durability v3
+# checkpoint restores fine) but never written.
+_STATE_TMPL = "state.{round}.msgpack"
+_LEGACY_STATE_FILE = "state.msgpack"
+# Orchestrator-specific extra sections (durability/snapshot.py): the
+# population engine's cohort/bank state, packed masks, ... — arbitrary
+# named numpy arrays in one .npz beside the state blob, json-able scalars
+# in meta["extra_meta"].  Absent when a snapshot has no extra sections.
+# (No legacy un-suffixed twin: extra sections and the suffixed layout
+# shipped together, so only state.msgpack has a pre-durability form.)
+_EXTRA_TMPL = "extra.{round}.npz"
+# Embedded in both payload files so a miscopied/spliced file is detected
+# by the round cross-check even though the commit ordering already rules
+# out writer-crash tearing.
+_EXTRA_ROUND_KEY = "__round__"
+
+
+def _payload_paths(directory: Path, round_num: int) -> Tuple[Path, Path]:
+    return (
+        directory / _STATE_TMPL.format(round=int(round_num)),
+        directory / _EXTRA_TMPL.format(round=int(round_num)),
+    )
+
+
+def _resolve_state_path(directory: Path, round_num: int) -> Path:
+    """The state blob ``meta.json`` (round ``round_num``) commits to —
+    generation-suffixed, or the legacy un-suffixed name for snapshots
+    written before the commit-point layout."""
+    state, _ = _payload_paths(directory, round_num)
+    if state.exists():
+        return state
+    legacy = directory / _LEGACY_STATE_FILE
+    if legacy.exists():
+        return legacy
+    return state  # let the caller's read raise with the canonical name
+
+
+def _gc_old_generations(directory: Path, keep_round: int) -> None:
+    """Delete payload generations other than the just-committed one
+    (including legacy un-suffixed files) — strictly AFTER the meta
+    replace, so a crash mid-save never touches the live snapshot."""
+    state_keep, extra_keep = _payload_paths(directory, keep_round)
+    keep = {state_keep.name, extra_keep.name}
+    for p in list(directory.glob("state.*.msgpack")) + list(
+        directory.glob("extra.*.npz")
+    ) + [directory / _LEGACY_STATE_FILE]:
+        if p.name not in keep:
+            try:
+                p.unlink()
+            except FileNotFoundError:
+                pass
 
 
 def save_checkpoint(
@@ -41,18 +114,28 @@ def save_checkpoint(
     round_num: int,
     history: Dict[str, list],
     round_times: list,
+    extra_arrays: Optional[Dict[str, np.ndarray]] = None,
+    extra_meta: Optional[Dict[str, Any]] = None,
 ) -> Path:
-    """Write a checkpoint; returns the directory written."""
+    """Write a checkpoint; returns the directory written.
+
+    ``extra_arrays``/``extra_meta`` are the durability extension
+    (durability/snapshot.py): named numpy arrays land in ``extra.<round>.npz``,
+    json-able metadata in ``meta.json["extra_meta"]``, and the section
+    names are listed in ``meta.json["sections"]`` so restore knows what a
+    complete snapshot of this run must contain.
+    """
     d = Path(directory)
     d.mkdir(parents=True, exist_ok=True)
+    extra_arrays = dict(extra_arrays or {})
     blob = serialization.to_bytes(
         {
             "params": jax.device_get(params),
             "agg_state": jax.device_get(agg_state),
             "rng": jax.device_get(rng),
             # Duplicated in meta.json; restore cross-checks the two so a
-            # crash landing between the two os.replace calls (new state,
-            # old meta) is detected instead of silently replaying rounds.
+            # hand-copied/spliced state file from another snapshot is
+            # detected instead of silently replaying rounds.
             "round": np.int64(round_num),
         }
     )
@@ -62,13 +145,28 @@ def save_checkpoint(
             "round": int(round_num),
             "history": history,
             "round_times": [float(t) for t in round_times],
+            "sections": sorted(extra_arrays),
+            "extra_meta": extra_meta or {},
         }
     )
-    # Each file is replaced atomically, but the pair is not: a crash between
-    # the two os.replace calls leaves NEW state beside OLD meta.  The round
-    # number embedded in the blob lets restore detect that torn pair.
-    durable_replace(d, STATE_FILE, blob)
+    # Commit-point ordering: the generation-suffixed payload files land
+    # (fsync'd) under names no live snapshot uses, the meta.json replace
+    # COMMITS them, and only then are older generations deleted.  A crash
+    # anywhere in this sequence leaves meta.json naming a generation whose
+    # files are complete — the previous snapshot before the commit, the
+    # new one after it.
+    state_path, extra_path = _payload_paths(d, round_num)
+    if extra_arrays:
+        durable_replace(
+            d, extra_path.name,
+            npz_bytes({
+                **extra_arrays,
+                _EXTRA_ROUND_KEY: np.asarray(round_num, np.int64),
+            }),
+        )
+    durable_replace(d, state_path.name, blob)
     durable_replace(d, META_FILE, meta.encode("utf-8"))
+    _gc_old_generations(d, round_num)
     return d
 
 
@@ -109,11 +207,17 @@ def restore_checkpoint(
     params_target: Any,
     agg_state_target: Dict[str, Any],
     rng_target: Any,
-) -> Tuple[Any, Dict[str, Any], Any, int, Dict[str, list], list]:
-    """Load (params, agg_state, rng, round, history, round_times).
+) -> Tuple[
+    Any, Dict[str, Any], Any, int, Dict[str, list], list,
+    Dict[str, np.ndarray], Dict[str, Any],
+]:
+    """Load (params, agg_state, rng, round, history, round_times,
+    extra_arrays, extra_meta).
 
     Targets supply the pytree structure/dtypes; shapes are validated by
-    flax.serialization against the saved leaves.
+    flax.serialization against the saved leaves.  ``extra_arrays`` holds
+    the sections ``meta.json["sections"]`` names (empty for snapshots
+    without extras), round-cross-checked like the state/meta pair.
     """
     d = Path(directory)
     meta = json.loads((d / META_FILE).read_text())
@@ -129,6 +233,7 @@ def restore_checkpoint(
         raise ValueError(
             f"Checkpoint version {meta.get('version')} != {CKPT_VERSION}{hint}"
         )
+    state_path = _resolve_state_path(d, meta["round"])
     state = serialization.from_bytes(
         {
             "params": jax.device_get(params_target),
@@ -136,15 +241,36 @@ def restore_checkpoint(
             "rng": jax.device_get(rng_target),
             "round": np.int64(0),
         },
-        (d / STATE_FILE).read_bytes(),
+        state_path.read_bytes(),
     )
     if int(state["round"]) != int(meta["round"]):
         raise ValueError(
-            f"Torn checkpoint: state.msgpack is at round {int(state['round'])} "
-            f"but meta.json says round {int(meta['round'])} — the writer "
-            "crashed between the two atomic replaces; restart from a clean "
-            "checkpoint directory"
+            f"Torn checkpoint: {state_path.name} is at round "
+            f"{int(state['round'])} but meta.json says round "
+            f"{int(meta['round'])} — the file was spliced from another "
+            "snapshot (the commit-point writer cannot produce this); "
+            "restart from a clean checkpoint directory"
         )
+    sections = list(meta.get("sections", []))
+    extra_arrays: Dict[str, np.ndarray] = {}
+    if sections:
+        extra_path = _payload_paths(d, meta["round"])[1]
+        extra_arrays = load_npz_bytes(extra_path.read_bytes())
+        extra_round = extra_arrays.pop(_EXTRA_ROUND_KEY, None)
+        if extra_round is None or int(extra_round) != int(meta["round"]):
+            raise ValueError(
+                f"Torn checkpoint: {extra_path.name} is at round "
+                f"{None if extra_round is None else int(extra_round)} but "
+                f"meta.json says round {int(meta['round'])} — the file was "
+                "spliced from another snapshot; restart from a clean "
+                "checkpoint directory"
+            )
+        missing = sorted(set(sections) - set(extra_arrays))
+        if missing:
+            raise ValueError(
+                f"Incomplete snapshot: meta.json lists sections {missing} "
+                "that the extra section file does not contain"
+            )
     return (
         state["params"],
         state["agg_state"],
@@ -152,9 +278,20 @@ def restore_checkpoint(
         int(meta["round"]),
         meta["history"],
         list(meta["round_times"]),
+        extra_arrays,
+        dict(meta.get("extra_meta", {})),
     )
 
 
 def has_checkpoint(directory: str | Path) -> bool:
+    """A restorable snapshot exists: a committed meta.json whose state
+    generation is present (suffixed or legacy layout)."""
     d = Path(directory)
-    return (d / STATE_FILE).exists() and (d / META_FILE).exists()
+    meta_path = d / META_FILE
+    if not meta_path.exists():
+        return False
+    try:
+        meta = json.loads(meta_path.read_text())
+    except (OSError, json.JSONDecodeError):
+        return False
+    return _resolve_state_path(d, meta.get("round", 0)).exists()

@@ -399,7 +399,8 @@ def _node_axis_sharded(config: Config, mesh=None) -> bool:
     return jax.device_count() > 1
 
 
-def build_gang_from_config(config: Config, seeds=None, mesh=None):
+def build_gang_from_config(config: Config, seeds=None, mesh=None,
+                           checkpoint_dir=None):
     """Gang wiring (core/gang.py): one traced round program, S stacked
     member experiments — the ``murmura sweep`` / ``murmura run --seeds``
     path.
@@ -581,13 +582,21 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None):
 
     writers = None
     if config.telemetry.enabled:
+        # A gang resuming from an existing snapshot appends to its
+        # members' event streams (the build_network_from_config contract,
+        # automatically keyed off the snapshot's existence).
+        gang_resume = False
+        if checkpoint_dir is not None:
+            from murmura_tpu.utils.checkpoint import has_checkpoint
+
+            gang_resume = has_checkpoint(checkpoint_dir)
         base_dir = default_telemetry_dir(config)
         writers = []
         for member in members:
             mcfg = config.model_copy(deep=True)
             mcfg.experiment.seed = member.seed
             mcfg.telemetry.dir = os.path.join(base_dir, member.label)
-            writers.append(build_telemetry_writer(mcfg))
+            writers.append(build_telemetry_writer(mcfg, resume=gang_resume))
 
     try:
         return GangNetwork(
@@ -619,14 +628,27 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None):
 
 
 def build_network_from_config(
-    config: Config, mesh=None, telemetry_resume: bool = False
+    config: Config, mesh=None, telemetry_resume: bool = False,
+    checkpoint_dir=None,
 ) -> Network:
     """Full wiring: data + model + aggregator + attack -> Network.
 
     ``telemetry_resume``: this Network will continue a prior run (the CLI
     --resume path) — its telemetry appends to the run dir's existing event
     stream instead of rotating it.
+
+    ``checkpoint_dir``: the durability snapshot location this run will
+    resume from, when given.  It makes the telemetry-resume decision
+    AUTOMATIC: the event stream appends exactly when a snapshot actually
+    exists there (a resumed run must never rotate its own stream to
+    ``*.prev``; a --resume with no snapshot yet is a fresh run and must
+    rotate a stale one) — the caller no longer has to keep two flags in
+    sync.
     """
+    if checkpoint_dir is not None:
+        from murmura_tpu.utils.checkpoint import has_checkpoint
+
+        telemetry_resume = has_checkpoint(checkpoint_dir)
     if config.backend == "tpu" and config.tpu.multihost and mesh is None:
         # Must run before ANY jax call that initializes the XLA backend
         # (the eval_shape below would); jax.distributed.initialize refuses

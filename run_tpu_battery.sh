@@ -14,6 +14,7 @@ PROFILE=0
 GANG=0
 POPULATION=0
 COMPRESS=0
+RESUME=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -21,6 +22,7 @@ while :; do
     --gang) GANG=1; shift;;
     --population) POPULATION=1; shift;;
     --compress) COMPRESS=1; shift;;
+    --resume) RESUME=1; shift;;
     *) break;;
   esac
 done
@@ -349,6 +351,112 @@ PYEOF
     exit 1
   fi
   echo "preflight compress clean" | tee -a "$OUT/battery.log"
+fi
+# Optional durability pre-flight (./run_tpu_battery.sh --resume [outdir]):
+# the ISSUE-10 crash-equivalence gate, with a REAL process death — a
+# subprocess trains the resumable example config 3 rounds, snapshots, and
+# SIGKILLs itself (no atexit, no finalization; everything past the
+# snapshot is genuinely lost).  A fresh process then resumes under
+# tpu.recompile_guard and must (a) restore exactly round 3, (b) finish
+# with a history byte-identical to an uninterrupted run (MUR901), and
+# (c) compile nothing after its warmup round (MUR902) — if kill-and-
+# resume drifts by one bit or one compile, every long battery run below
+# is unrecoverable and the whole durability story is fiction.  CPU-pinned
+# like the other gates.
+if [ "$RESUME" = 1 ]; then
+  echo "=== preflight: durability kill/resume (crash-equivalence) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  DUR_DIR="$OUT/resume_preflight"
+  rm -rf "$DUR_DIR"
+  if ! timeout 900 env JAX_PLATFORMS=cpu MURMURA_DUR_DIR="$DUR_DIR" python - > "$OUT/preflight_resume.out" 2>&1 <<'PYEOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+from pathlib import Path
+
+import yaml
+
+from murmura_tpu.analysis.durability import history_equal
+from murmura_tpu.config import Config
+from murmura_tpu.utils.checkpoint import has_checkpoint
+from murmura_tpu.utils.factories import build_network_from_config
+
+dur_dir = Path(os.environ["MURMURA_DUR_DIR"])
+ckpt = dur_dir / "ckpt"
+raw = yaml.safe_load(Path("examples/configs/resumable_run.yaml").read_text())
+raw["experiment"]["rounds"] = 6
+raw["experiment"]["verbose"] = False
+raw["telemetry"]["enabled"] = False
+raw["durability"]["checkpoint_dir"] = str(ckpt)
+raw["durability"]["checkpoint_every"] = 3
+(dur_dir / "config.json").parent.mkdir(parents=True, exist_ok=True)
+(dur_dir / "config.json").write_text(json.dumps(raw))
+
+# -- uninterrupted reference (same build path the victim/resumer use) ----
+ref = build_network_from_config(Config.model_validate(raw))
+ref.train(rounds=6)
+ref_hist = {k: list(v) for k, v in ref.history.items()}
+
+# -- victim: train 3 rounds, snapshot, then die by SIGKILL ---------------
+victim = r"""
+import json, os, signal, sys
+from pathlib import Path
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+raw = json.loads(Path(sys.argv[1]).read_text())
+net = build_network_from_config(Config.model_validate(raw))
+net.train(rounds=3)
+net.save_checkpoint(raw["durability"]["checkpoint_dir"])
+print("victim: snapshot written, dying", flush=True)
+os.kill(os.getpid(), signal.SIGKILL)
+"""
+proc = subprocess.run(
+    [sys.executable, "-c", victim, str(dur_dir / "config.json")],
+    capture_output=True, text=True,
+    env={**os.environ, "JAX_PLATFORMS": "cpu"},
+)
+if proc.returncode != -signal.SIGKILL:
+    print(f"victim did not die by SIGKILL (rc={proc.returncode}):\n"
+          f"{proc.stdout}\n{proc.stderr}")
+    sys.exit(1)
+if not has_checkpoint(ckpt):
+    print(f"victim died without a snapshot in {ckpt}")
+    sys.exit(1)
+meta = json.loads((ckpt / "meta.json").read_text())
+if meta["round"] != 3:
+    print(f"snapshot round {meta['round']} != 3")
+    sys.exit(1)
+
+# -- resume: fresh process state, recompile-guarded continuation ---------
+raw["tpu"] = dict(raw.get("tpu") or {}, recompile_guard=True)
+resumed = build_network_from_config(
+    Config.model_validate(raw), checkpoint_dir=str(ckpt)
+)
+done = resumed.restore_checkpoint(str(ckpt))
+if done != 3:
+    print(f"restore returned round {done}, expected 3")
+    sys.exit(1)
+# tpu.recompile_guard raises RecompileError on ANY post-warmup compile —
+# the 3 resumed rounds under the guard ARE the zero-recompile assertion.
+resumed.train(rounds=3)
+res_hist = {k: list(v) for k, v in resumed.history.items()}
+if not history_equal(ref_hist, res_hist):
+    diverged = sorted(
+        k for k in ref_hist
+        if not history_equal(ref_hist[k], res_hist.get(k, []))
+    )
+    print(f"resumed history diverged from uninterrupted run in {diverged}")
+    sys.exit(1)
+print("kill/resume ok: victim SIGKILLed after round 3, resumed history "
+      "byte-identical over 6 rounds, zero post-warmup recompiles")
+PYEOF
+  then
+    echo "preflight resume FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_resume.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight resume clean" | tee -a "$OUT/battery.log"
 fi
 run bench          2400 python bench.py
 run breakdown      2400 python bench_breakdown.py

@@ -266,6 +266,7 @@ class TestBudgets:
             for f in fs
         )
 
+    @pytest.mark.slow  # regen sweep; the committed-budget gate stays tier-1
     def test_update_budgets_roundtrip(self, tmp_path):
         p = budgets.update_budgets(tmp_path / "BUDGETS.json")
         fs, deltas = budgets.check_budgets(p)

@@ -4,6 +4,7 @@ device or sharded over the 8-virtual-device CPU mesh
 (SURVEY.md §4 test plan items (b)/(c))."""
 
 import numpy as np
+import pytest
 
 from murmura_tpu.config import Config
 from murmura_tpu.utils.factories import build_network_from_config
@@ -54,6 +55,7 @@ def test_tpu_backend_learns_under_attack():
     assert hist["honest_accuracy"][-1] > 0.5  # Krum resists 25% gaussian
 
 
+@pytest.mark.slow
 def test_wearable_window_params_sync_model_input_dim():
     # Non-default window params change sample dimensionality; the model
     # input must follow without a hand-set input_dim.

@@ -62,45 +62,84 @@ def test_checkpoint_resume_bit_identical(tmp_path):
     )
 
 
-def test_torn_checkpoint_detected(tmp_path):
-    """A crash between the state and meta replaces leaves new state beside
-    old meta; restore must refuse rather than silently replay rounds."""
+def test_spliced_state_file_detected(tmp_path):
+    """The embedded-round cross-check (defense in depth behind the
+    commit-point ordering): a state blob copied in from another snapshot
+    under the committed generation's name must be refused, not silently
+    restored at the wrong round."""
     import json
+    import shutil
 
     import pytest
 
     ckpt = tmp_path / "ckpt"
     net = _make_network()
-    net.train(rounds=2, checkpoint_dir=str(ckpt))
-
-    meta_path = ckpt / "meta.json"
-    meta = json.loads(meta_path.read_text())
-    meta["round"] = meta["round"] - 1  # simulate stale meta beside new state
-    meta_path.write_text(json.dumps(meta))
+    net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    round2 = ckpt / "state.2.msgpack"
+    keep = tmp_path / "state.round2.bak"
+    shutil.copy(round2, keep)
+    net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    meta = json.loads((ckpt / "meta.json").read_text())
+    assert meta["round"] == 4
+    # Splice the round-2 blob under the committed round-4 name.
+    shutil.copy(keep, ckpt / "state.4.msgpack")
 
     fresh = _make_network()
     with pytest.raises(ValueError, match="[Tt]orn"):
         fresh.restore_checkpoint(str(ckpt))
 
 
-def test_torn_pair_new_state_old_meta_detected(tmp_path):
-    """The exact torn pair the save-path docstring promises to catch: a
-    crash landing BETWEEN the two os.replace calls leaves the NEW
-    state.msgpack beside the OLD meta.json.  Reproduced with two real
-    checkpoints (not hand-edited JSON): splice the round-2 meta next to
-    the round-4 state and restore must refuse loudly."""
-    import pytest
+def test_crash_before_meta_commit_restores_previous_snapshot(tmp_path):
+    """THE durability guarantee (ISSUE 10): meta.json is the commit
+    point, so a crash landing after the new state generation is written
+    but BEFORE the meta replace must leave the PREVIOUS snapshot fully
+    restorable — not a torn pair that loses the run.  Reproduced with two
+    real checkpoints: put the round-2 meta back beside the round-4 state
+    generation (exactly the on-disk picture such a crash leaves, old
+    generation not yet GC'd) and restore must come back at round 2."""
+    import shutil
 
     ckpt = tmp_path / "ckpt"
     net = _make_network()
     net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
     old_meta = (ckpt / "meta.json").read_bytes()
+    old_state = (ckpt / "state.2.msgpack").read_bytes()
     net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
-    (ckpt / "meta.json").write_bytes(old_meta)  # crash before meta replace
+    # Reconstruct the crash window: new state.4.msgpack on disk, meta
+    # still the round-2 commit, round-2 generation still present.
+    (ckpt / "meta.json").write_bytes(old_meta)
+    (ckpt / "state.2.msgpack").write_bytes(old_state)
 
     fresh = _make_network()
-    with pytest.raises(ValueError, match="[Tt]orn"):
-        fresh.restore_checkpoint(str(ckpt))
+    assert fresh.restore_checkpoint(str(ckpt)) == 2
+    assert fresh.current_round == 2
+
+
+def test_legacy_unsuffixed_snapshot_restores(tmp_path):
+    """A pre-commit-point v3 checkpoint (plain state.msgpack beside
+    meta.json) must still restore — and the next save must migrate the
+    directory to the suffixed layout."""
+    ckpt = tmp_path / "ckpt"
+    net = _make_network()
+    net.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    (ckpt / "state.2.msgpack").rename(ckpt / "state.msgpack")
+    assert has_checkpoint(ckpt)
+
+    fresh = _make_network()
+    assert fresh.restore_checkpoint(str(ckpt)) == 2
+    fresh.train(rounds=2, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    assert not (ckpt / "state.msgpack").exists()
+    assert (ckpt / "state.4.msgpack").exists()
+
+
+def test_old_generations_garbage_collected(tmp_path):
+    """After a committed save, exactly one state generation remains."""
+    ckpt = tmp_path / "ckpt"
+    net = _make_network()
+    net.train(rounds=4, checkpoint_dir=str(ckpt), checkpoint_every=2)
+    assert [p.name for p in sorted(ckpt.glob("state.*"))] == [
+        "state.4.msgpack"
+    ]
 
 
 def test_save_leaves_no_temp_files(tmp_path):

@@ -632,6 +632,7 @@ class TestMonitorTelemetry:
         assert mon._node_counters[0]["send_retries"] == 3.0
         assert mon._node_counters[0]["checkpoint_s"] == 0.5
 
+    @pytest.mark.slow
     def test_counters_and_history_fold_into_manifest(self, tmp_path):
         from murmura_tpu.telemetry.writer import (
             events_of_type,

@@ -135,6 +135,7 @@ class TestParity:
         )
         assert a == b
 
+    @pytest.mark.slow
     def test_faulted_gang_matches_single_runs(self):
         faults = {"enabled": True, "seed": 9, "crash_prob": 0.2,
                   "recovery_prob": 0.5, "link_drop_prob": 0.1}
@@ -394,15 +395,31 @@ class TestCli:
         assert result.exit_code == 0, result.output
         assert sorted(json.loads(out.read_text())) == ["seed_3", "seed_4"]
 
-    def test_run_seeds_rejects_checkpointing(self, tmp_path):
+    def test_run_seeds_checkpoints_the_gang(self, tmp_path):
+        # ISSUE-10 lifted the old rejection: --seeds N now snapshots the
+        # full stacked gang state (durability/snapshot.py).
+        from click.testing import CliRunner
+
+        from murmura_tpu.cli import app
+        from murmura_tpu.utils.checkpoint import has_checkpoint
+
+        p = self._write(tmp_path, _raw(3))
+        ckpt = tmp_path / "ckpt"
+        result = CliRunner().invoke(
+            app,
+            ["run", str(p), "--seeds", "2", "--checkpoint-dir", str(ckpt)],
+        )
+        assert result.exit_code == 0, result.output
+        assert has_checkpoint(ckpt)
+
+    def test_run_seeds_rejects_profile(self, tmp_path):
         from click.testing import CliRunner
 
         from murmura_tpu.cli import app
 
         p = self._write(tmp_path, _raw(3))
         result = CliRunner().invoke(
-            app,
-            ["run", str(p), "--seeds", "2", "--checkpoint-dir", str(tmp_path)],
+            app, ["run", str(p), "--seeds", "2", "--profile"]
         )
         assert result.exit_code != 0
 

@@ -381,6 +381,7 @@ def test_telemetry_example_config_validates():
     assert cfg.faults.enabled and cfg.aggregation.algorithm == "krum"
 
 
+@pytest.mark.slow
 def test_fused_profile_window_opens_mid_chunk(tmp_path):
     """A profile window starting strictly INSIDE a fused chunk must still
     capture: the chunk dispatches rounds [0, 4) as one program, so overlap

@@ -26,6 +26,7 @@ import os
 import subprocess
 import sys
 import time
+from pathlib import Path
 
 # Peak dense matmul throughput per chip, bf16 (f32 for v2/v3, which have
 # no bf16-vs-f32 MXU split in the public numbers), from public TPU specs
@@ -184,6 +185,41 @@ def fallback_reason_from_probe(backend: str, probe_log) -> "str | None":
     return "default jax backend is cpu (no TPU attached)"
 
 
+def existing_bench_platform(run_dir) -> "str | None":
+    """The ``platform`` stamp of the bench manifest already in
+    ``run_dir`` (None when absent/unstamped — pre-stamp artifacts carry
+    no platform and are overwritable)."""
+    try:
+        from murmura_tpu.telemetry.writer import read_manifest
+
+        manifest = read_manifest(run_dir)
+    except Exception:  # noqa: BLE001 — an unreadable manifest blocks nothing
+        return None
+    if not manifest:
+        return None
+    return (manifest.get("summary") or {}).get("platform")
+
+
+def refuse_platform_shadowing(what: str, existing: "str | None",
+                              new: str, force: bool, script: str) -> None:
+    """Refuse to MERGE a new artifact over one measured on a different
+    platform unless --force: per-point ``platform`` stamps landed with
+    ISSUE 10, but the r03-r05 CPU-fallback artifacts still silently
+    shadowed TPU history because nothing guarded the overwrite.  Exits 2
+    BEFORE anything is measured, so no sweep time is wasted on numbers
+    that would be refused at write time."""
+    if existing is None or existing == new or force:
+        return
+    print(
+        f"{script}: refusing to overwrite {what} (measured on platform "
+        f"'{existing}') with a new '{new}' artifact — a CPU-fallback "
+        "sweep silently shadowing chip history is the r03-r05 failure "
+        "mode; pass --force to overwrite anyway",
+        file=sys.stderr, flush=True,
+    )
+    raise SystemExit(2)
+
+
 def _peak_flops(device_kind: str):
     kind = device_kind.lower()
     for key, peak in PEAK_FLOPS.items():
@@ -264,6 +300,12 @@ def main():
              "the TPU probe fails — no more CPU numbers labeled by hope "
              "(BENCH r03-r05).  Env twin: MURMURA_REQUIRE_TPU=1.",
     )
+    ap.add_argument(
+        "--force", action="store_true",
+        help="Overwrite the existing bench manifest even when its "
+             "platform stamp differs from this run's (default: refuse — "
+             "a CPU-fallback run must not silently shadow TPU history).",
+    )
     args = ap.parse_args()
     require = (
         args.require_tpu or os.environ.get("MURMURA_REQUIRE_TPU") == "1"
@@ -275,6 +317,13 @@ def main():
     # JSON so a fallback is attributable in the artifact itself, not just
     # the probe log (the r03-r05 mislabeling fix).
     fallback_reason = fallback_reason_from_probe(backend, probe_log)
+    refuse_platform_shadowing(
+        "telemetry_runs/bench/manifest.json",
+        existing_bench_platform(
+            Path(__file__).parent / "telemetry_runs" / "bench"
+        ),
+        "cpu" if on_cpu else backend, args.force, "bench",
+    )
     if require and on_cpu:
         print(
             f"bench: --require-tpu/MURMURA_REQUIRE_TPU set but the run "

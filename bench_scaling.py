@@ -325,6 +325,11 @@ def main():
                          "circulant grid; writes bench_scaling_sparse.json")
     ap.add_argument("--timeout", type=float, default=1800.0)
     ap.add_argument("--out", default=None)
+    ap.add_argument("--force", action="store_true",
+                    help="Overwrite an existing artifact whose platform "
+                         "stamp differs from this run's (default: refuse "
+                         "— a CPU-fallback sweep must not silently "
+                         "shadow TPU history).")
     args = ap.parse_args()
     if args.out is None:
         args.out = str(Path(__file__).parent / (
@@ -337,10 +342,22 @@ def main():
                   variant=args.variant, require_tpu=args.require_tpu)
         return
 
-    from bench import fallback_reason_from_probe, probe_backend
+    from bench import (
+        fallback_reason_from_probe,
+        probe_backend,
+        refuse_platform_shadowing,
+    )
 
     backend, device_kind, probe_log = probe_backend()
     on_cpu = "cpu" in backend
+    try:
+        existing = json.loads(Path(args.out).read_text()).get("platform")
+    except (OSError, ValueError):
+        existing = None
+    refuse_platform_shadowing(
+        args.out, existing, "cpu" if on_cpu else backend, args.force,
+        "bench_scaling",
+    )
     if on_cpu:
         fallback_reason = fallback_reason_from_probe(backend, probe_log)
         if (

@@ -41,6 +41,7 @@ def run_check_detailed(
     budget_path=None,
     flow: Optional[bool] = None,
     durability: Optional[bool] = None,
+    adaptive: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -52,10 +53,14 @@ def run_check_detailed(
     MUR800-804), and when ``durability`` is enabled the executable
     resume-determinism contract (analysis/durability.py, MUR901/902:
     save→restore→replay byte-equality + zero-recompile restore per
-    rule x exchange mode).  ``ir=None``/``flow=None``/``durability=None``
-    mean "on for the package check, off for explicit paths" (all three
-    passes are package-global: they exercise the live registry, not the
-    files named on the command line).
+    rule x exchange mode), and when ``adaptive`` is enabled the
+    adaptive-adversary contracts (analysis/adaptive.py, MUR1000-1003:
+    attack-state registry bijection, recompile-free adaptation,
+    collective-inventory parity, feedback taint containment).
+    ``ir=None``/``flow=None``/``durability=None``/``adaptive=None`` mean
+    "on for the package check, off for explicit paths" (all four passes
+    are package-global: they exercise the live registry, not the files
+    named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -66,6 +71,7 @@ def run_check_detailed(
     run_ir = ir if ir is not None else not paths
     run_flow = flow if flow is not None else not paths
     run_durability = durability if durability is not None else not paths
+    run_adaptive = adaptive if adaptive is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -89,6 +95,10 @@ def run_check_detailed(
         from murmura_tpu.analysis import durability as durability_mod
 
         findings.extend(durability_mod.check_durability())
+    if run_adaptive:
+        from murmura_tpu.analysis import adaptive as adaptive_mod
+
+        findings.extend(adaptive_mod.check_adaptive())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -99,11 +109,13 @@ def run_check(
     ir: Optional[bool] = None,
     flow: Optional[bool] = None,
     durability: Optional[bool] = None,
+    adaptive: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
-        paths, contracts=contracts, ir=ir, flow=flow, durability=durability
+        paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
+        adaptive=adaptive,
     )[0]
 
 

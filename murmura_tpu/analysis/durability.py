@@ -25,9 +25,9 @@ Both hold *by construction* — every random stream is a pure function of
 a finding here is a real regression: a new piece of carried state that
 missed the snapshot, or a restore path that perturbs placement.
 
-The grid is ``AGGREGATORS x (dense, circulant, sparse, compressed)`` —
-the same rule inventory the IR/flow/budget sweeps use (``AGG_CASES``
-keeps the bijection under MUR205).  Cells are tiny (5-8 nodes, an
+The grid is ``AGGREGATORS x (dense, circulant, sparse, compressed,
+adaptive)`` — the same rule inventory the IR/flow/budget sweeps use
+(``AGG_CASES`` keeps the bijection under MUR205).  Cells are tiny (5-8 nodes, an
 83-param MLP, 4 rounds) but compile-dominated (~3-4 s each), so the full
 sweep is memoized per process and runs by default only for the package
 check, like ``check_ir``/``check_flow``.  Tests gate a representative
@@ -46,13 +46,16 @@ import numpy as np
 
 from murmura_tpu.analysis.lint import Finding
 
-# The four exchange formulations a rule's math can take (ISSUE 7/8
+# The exchange formulations a rule's math can take (ISSUE 7/8
 # vocabulary): dense allgather, circulant ppermute shifts, the sparse
 # [k, N] edge-mask engine, and the int8+error-feedback codec (the mode
 # with round-crossing COMPRESS_STATE_KEYS state — the one a shallow
-# checkpoint would silently corrupt).
+# checkpoint would silently corrupt).  ``adaptive`` (ISSUE 11) runs the
+# dense exchange under a closed-loop bisection attack: the mode with
+# round-crossing ATTACK_STATE_KEYS state — a snapshot that dropped the
+# attacker's bracket would resume a silently-cold adversary.
 DURABILITY_MODES: Tuple[str, ...] = (
-    "dense", "circulant", "sparse", "compressed"
+    "dense", "circulant", "sparse", "compressed", "adaptive"
 )
 
 # Registry of check families in this module: name -> callable, scanned by
@@ -119,6 +122,10 @@ def _cell_config(rule: str, mode: str):
     elif mode == "compressed":
         raw["compression"] = {"algorithm": "int8", "error_feedback": True,
                               "block": 64}
+    elif mode == "adaptive":
+        raw["attack"] = {"enabled": True, "type": "gaussian",
+                         "percentage": 0.3, "params": {"noise_std": 5.0},
+                         "adaptive": {"enabled": True}}
     elif mode != "dense":
         raise ValueError(f"unknown durability mode {mode!r}")
     return Config.model_validate(raw)

@@ -1589,6 +1589,7 @@ def build_flow_cell(
     n: int = FLOW_N,
     agg_override: Any = None,
     params: Optional[Dict[str, Any]] = None,
+    audit: bool = False,
 ) -> FlowCell:
     """Instantiate one rule over one flow-grid cell.
 
@@ -1596,6 +1597,10 @@ def build_flow_cell(
     graph (the dense mode takes its [N, N] matrix, the circulant/sparse/
     compressed modes its offsets), so the analyzed influence cardinality
     is comparable across modes — the MUR802 parity subject.
+
+    ``audit`` builds the cell with ``ctx.audit`` on so the rule emits its
+    per-node ``tap_*`` stats — the MUR1003 adaptive-feedback cells
+    (analysis/adaptive.py) analyze the acceptance signal those taps feed.
     """
     import dataclasses as dc
 
@@ -1608,7 +1613,10 @@ def build_flow_cell(
 
     if mode not in FLOW_MODES:
         raise ValueError(f"unknown flow mode {mode!r}")
-    default_cell = agg_override is None and params is None and n == FLOW_N
+    default_cell = (
+        agg_override is None and params is None and n == FLOW_N
+        and not audit
+    )
     if default_cell and (name, mode) in _CELL_MEMO:
         return _CELL_MEMO[(name, mode)]
     offsets = _flow_offsets(n)
@@ -1647,6 +1655,7 @@ def build_flow_cell(
         evidential=evidential,
         num_classes=_PROBE_CLASSES,
         total_rounds=10,
+        audit=audit,
     )
     if name in _PROBE_RULES:
         probe = {

@@ -126,7 +126,8 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 # check_flow / analysis/durability's check_durability are their own
 # runners composed by run_check_detailed.
 _CHECK_ENTRY_POINTS = frozenset(
-    {"check_ir", "check_coverage", "check_flow", "check_durability"}
+    {"check_ir", "check_coverage", "check_flow", "check_durability",
+     "check_adaptive"}
 )
 
 
@@ -1640,6 +1641,7 @@ def check_coverage() -> List[Finding]:
             f"AGG_CASES entry '{name}' names no registered aggregation "
             "rule — remove the stale canonical case",
         ))
+    from murmura_tpu.analysis import adaptive as adaptive_mod
     from murmura_tpu.analysis import durability as durability_mod
     from murmura_tpu.analysis import flow as flow_mod
 
@@ -1652,6 +1654,11 @@ def check_coverage() -> List[Finding]:
     findings.extend(
         _unwired_family_findings(
             durability_mod, durability_mod.DURABILITY_CHECK_FAMILIES
+        )
+    )
+    findings.extend(
+        _unwired_family_findings(
+            adaptive_mod, adaptive_mod.ADAPTIVE_CHECK_FAMILIES
         )
     )
     return findings

@@ -107,6 +107,17 @@ RULES: Dict[str, str] = {
     "MUR802": "influence-mode-parity",
     "MUR803": "flow-scrub-dominance",
     "MUR804": "flow-zero-denominator",
+    # 9xx = durability contracts (analysis/contracts.py MUR900;
+    # analysis/durability.py MUR901/902; docs/ROBUSTNESS.md)
+    "MUR900": "snapshot-completeness",
+    "MUR901": "resume-determinism",
+    "MUR902": "resume-recompile",
+    # 10xx = adaptive-adversary contracts (analysis/adaptive.py;
+    # docs/ROBUSTNESS.md "Adaptive adversaries & the frontier")
+    "MUR1000": "attack-state-registry",
+    "MUR1001": "adaptive-attack-recompile",
+    "MUR1002": "adaptive-collective-inventory",
+    "MUR1003": "adaptive-influence-containment",
 }
 
 

@@ -7,6 +7,13 @@ from murmura_tpu.attacks.topology_liar import make_topology_liar_attack, false_c
 from murmura_tpu.attacks.alie import make_alie_attack
 from murmura_tpu.attacks.ipm import make_ipm_attack
 from murmura_tpu.attacks.label_flip import make_label_flip, poison_labels
+from murmura_tpu.attacks.adaptive import (
+    ADAPTIVE_ATTACKS,
+    ATTACK_STATE_KEYS,
+    AdaptiveAttack,
+    make_adaptive_alie_attack,
+    make_bisection_attack,
+)
 
 ATTACKS = {
     "gaussian": make_gaussian_attack,
@@ -19,6 +26,7 @@ ATTACKS = {
 
 __all__ = [
     "Attack",
+    "AdaptiveAttack",
     "select_compromised",
     "make_gaussian_attack",
     "make_directed_deviation_attack",
@@ -26,7 +34,11 @@ __all__ = [
     "make_alie_attack",
     "make_ipm_attack",
     "make_label_flip",
+    "make_adaptive_alie_attack",
+    "make_bisection_attack",
     "poison_labels",
     "false_claims",
     "ATTACKS",
+    "ADAPTIVE_ATTACKS",
+    "ATTACK_STATE_KEYS",
 ]

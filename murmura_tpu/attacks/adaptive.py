@@ -1,0 +1,418 @@
+"""In-jit closed-loop (adaptive) attacks — ISSUE 11, docs/ROBUSTNESS.md.
+
+Every static attack in this package closes over a fixed strength for the
+whole run, so the robustness story only ever tests each rule against
+adversaries that do not fight back.  This module closes the loop *inside*
+the compiled round program: per-attack adaptation state rides ``agg_state``
+under the reserved :data:`ATTACK_STATE_KEYS` (the ``COMPRESS_STATE_KEYS``/
+``DMTT_STATE_KEYS`` pattern, so durability snapshots and the MUR900
+completeness bijection pick it up for free), and each round the attacker
+reads the audit-tap acceptance signal the aggregation rule itself emitted
+(``tap_selected_by``/``tap_considered_by`` — telemetry leg of PR 4) for its
+compromised rows and tunes its strength for the next round.
+
+Two adaptive attacks ship:
+
+- **adaptive ALIE** (:func:`make_adaptive_alie_attack`): the colluding
+  vector's deviation factor ``z`` is per-node carried state updated by a
+  multiplicative variance-quantile walk — accepted rounds push ``z`` up
+  (the colluders creep toward the krum/BALANCE margin), rejected rounds
+  pull it back inside the benign variance envelope.  The equilibrium z
+  IS the empirical selection margin of the defense.
+- **scale bisection** (:func:`make_bisection_attack`): a generic wrapper
+  that turns ANY static broadcast attack into "largest strength still
+  accepted" — per-node bracket state (``atk_lo`` = largest accepted,
+  ``atk_hi`` = smallest rejected) drives a growth-then-bisection probe of
+  the perturbation multiplier.
+
+Design invariants (machine-checked by the MUR100x family,
+analysis/adaptive.py):
+
+- **Node-local feedback** — the acceptance signal is assembled from
+  per-node tap columns the rules already compute (roll-assembled on
+  circulant paths), and every state update is elementwise over node rows:
+  the feedback path adds NO collectives beyond the static-attack tapped
+  inventory (MUR1002) and no recompiles across strength/round variation
+  (MUR1001).
+- **Snapshot completeness** — all adaptation state lives under
+  :data:`ATTACK_STATE_KEYS` in ``agg_state`` (MUR1000 bijection into the
+  MUR900 registry), so a SIGKILL/`--resume` cycle restores the attacker
+  mid-bisection byte-identically (the MUR901 grid's ``adaptive`` cell).
+- **Bounded influence survives the loop** — taint from the adaptation
+  state flows into the *attacker's* broadcast rows only; a bounded rule's
+  per-coordinate influence cardinality is unchanged (MUR1003).
+
+Rules that emit no selection taps (fedavg, median, trimmed_mean,
+geometric_median, sketchguard) give the attacker only the fault
+sentinel's scrub-survival signal (when faults are armed) or a constant
+"accepted" — the adaptive program still compiles and runs against every
+rule, it just has less to adapt to; the frontier treats those curves as
+upper envelopes.  Quarantined/scrubbed compromised rows count as
+REJECTED observations (the attack was too loud); dead rows (churn) are
+not observations at all — their taps are masked out of the EMA entirely.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from murmura_tpu.attacks.base import Attack
+from murmura_tpu.attacks.alie import resolve_alie_z
+
+# Reserved round-program-level agg_state keys for attack adaptation state
+# (the COMPRESS_STATE_KEYS pattern; registered in
+# durability/snapshot.RESERVED_AGG_STATE_KEY_GROUPS so the MUR900 snapshot
+# completeness bijection — and therefore SIGKILL/--resume — covers the
+# attacker's bracket/EMA state for free).  Every adaptive attack's
+# init_attack_state() keys must be drawn from this tuple and their union
+# must equal it exactly (MUR1000, analysis/adaptive.py).  All entries are
+# per-node [N] float32 rows, so gang vmap and the durability snapshot
+# treat them exactly like any other node-indexed carried state.
+ATTACK_STATE_KEYS = (
+    "atk_accept_ema",  # EMA of the row's acceptance fraction
+    "atk_hi",          # bisection: smallest strength observed rejected
+    "atk_lo",          # bisection: largest strength observed accepted
+    "atk_scale",       # bisection: strength probed next round
+    "atk_z",           # adaptive ALIE: current deviation factor z
+)
+
+
+@dataclass(frozen=True)
+class AdaptiveAttack(Attack):
+    """A closed-loop attack: the static :class:`Attack` interface plus the
+    adaptation triple (init state / strength-aware apply / feedback
+    update).  ``apply`` stays populated with the initial-strength static
+    transform so code paths that do not know about adaptation (direct
+    library use) degrade to the static attack instead of crashing; the
+    round program routes through ``apply_adaptive`` (core/rounds.py).
+    """
+
+    # agg_state keys this attack carries (subset of ATTACK_STATE_KEYS).
+    state_keys: Tuple[str, ...] = ()
+    # (num_nodes) -> {key: [N] float32} initial adaptation state.
+    init_attack_state: Optional[Callable[[int], Dict[str, np.ndarray]]] = (
+        field(default=None)
+    )
+    # (flat[N, P], compromised[N], key, round_idx, state) -> bcast'[N, P]
+    apply_adaptive: Optional[Callable] = field(default=None)
+    # (state, accept[N], observed[N], compromised[N]) -> state'
+    update_attack_state: Optional[Callable] = field(default=None)
+    # (state, compromised[N]) -> {stat: [N]} telemetry rows (masked to the
+    # compromised set so history means read as coalition strength).
+    strength_stats: Optional[Callable] = field(default=None)
+
+
+def acceptance_feedback(
+    agg_stats: Dict[str, jnp.ndarray],
+    fault_stats: Dict[str, jnp.ndarray],
+    in_degree: jnp.ndarray,
+    alive: Optional[jnp.ndarray],
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The per-node acceptance signal an adaptive attacker reads each
+    round: ``(accept[N] in [0, 1], observed[N] in {0, 1})``.
+
+    ``accept[i]`` is the fraction of peers that selected/accepted node
+    i's broadcast this round (``tap_selected_by / tap_considered_by``
+    when the rule emits selection taps; a constant 1 otherwise — rules
+    without taps leave the attacker blind, which is itself part of the
+    robustness story).  A row the fault sentinel scrubbed or quarantined
+    counts as REJECTED (accept forced to 0 — the attack overflowed), not
+    as missing.  ``observed[i]`` gates the state update: dead rows
+    (``alive == 0``) broadcast nothing and must not move the EMA at all
+    — the churn-composition contract (tests/test_adaptive.py).
+
+    Which branches exist is a trace-time property of the rule/audit
+    configuration, so the lowered program is static per build; everything
+    here is elementwise over node-local rows — no collectives (MUR1002).
+    """
+    sel = agg_stats.get("tap_selected_by")
+    if sel is not None:
+        cons = agg_stats.get("tap_considered_by")
+        denom = cons if cons is not None else in_degree
+        denom = jnp.maximum(denom.astype(jnp.float32), 1.0)
+        accept = jnp.clip(sel.astype(jnp.float32) / denom, 0.0, 1.0)
+        observed = (
+            (cons if cons is not None else in_degree) > 0
+        ).astype(jnp.float32)
+    else:
+        accept = jnp.ones_like(in_degree, dtype=jnp.float32)
+        observed = jnp.ones_like(in_degree, dtype=jnp.float32)
+    scrubbed = fault_stats.get("tap_attack_scrubbed")
+    if scrubbed is not None:
+        # An overflow scrub IS an observation: the row was rejected.
+        accept = accept * (1.0 - scrubbed)
+        observed = jnp.maximum(observed, scrubbed)
+    quarantined = fault_stats.get("tap_quarantined")
+    if quarantined is not None:
+        accept = accept * (1.0 - quarantined)
+        observed = jnp.maximum(observed, quarantined)
+    if alive is not None:
+        # A dead node broadcast nothing — no signal, no update.
+        observed = observed * alive
+    return accept, observed
+
+
+def _gated(update_mask, new, old):
+    """Elementwise state update gated by the per-node observation mask."""
+    return jnp.where(update_mask > 0, new, old)
+
+
+def coalition_stats(
+    flat: jnp.ndarray, compromised_mask: jnp.ndarray, estimator: str
+):
+    """(mu[1, P], var[1, P]) of the ALIE construction under either
+    estimator, reduced in f32 (the honest_mean rationale, base.py):
+
+    - ``omniscient``: statistics over the TRUE honest rows — strictly
+      stronger than the paper (the historical in-jit default; results
+      labeled "ALIE" from it carry that caveat, alie.py docstring);
+    - ``coalition``: statistics over the compromised rows' own
+      benign-trained states ONLY — Baruch et al.'s actual construction
+      (the ZMQ backend's estimator, now available in-jit).  Requires the
+      colluders to train locally (``Attack.trains_locally``), else the
+      sample is frozen init params, not benign grad", and >= 2 colluders
+      for a non-degenerate sigma.
+    """
+    if estimator not in ("omniscient", "coalition"):
+        raise ValueError(
+            f"ALIE estimator must be 'omniscient' or 'coalition', "
+            f"got {estimator!r}"
+        )
+    f32 = flat.astype(jnp.float32)
+    comp = compromised_mask.astype(jnp.float32)[:, None]  # [N, 1]
+    w = comp if estimator == "coalition" else (1.0 - comp)
+    cnt = jnp.maximum(w.sum(), 1.0)
+    mu = (f32 * w).sum(axis=0, keepdims=True) / cnt
+    var = (jnp.square(f32 - mu) * w).sum(axis=0, keepdims=True) / cnt
+    return mu, var
+
+
+def make_adaptive_alie_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    z: Optional[float] = None,
+    seed: int = 42,
+    estimator: str = "omniscient",
+    eta: float = 0.25,
+    accept_target: float = 0.0,
+    ema_beta: float = 0.5,
+    z_min: float = 0.05,
+    z_cap: Optional[float] = None,
+) -> AdaptiveAttack:
+    """ALIE whose deviation factor z is carried per-node state updated by
+    a multiplicative variance-quantile walk against the observed
+    acceptance: accepted rounds multiply z by ``1 + eta`` (creep toward
+    the selection margin), rejected rounds by ``1 - eta`` (duck back
+    inside the benign envelope), clamped to ``[z_min, z_cap]``.  The
+    starting z is the paper's z_max (or the explicit override), exactly
+    the static attack's strength — an adaptive run whose defense never
+    rejects anything escalates from there.
+
+    "Accepted" means the round's acceptance fraction is STRICTLY above
+    ``accept_target`` — with the default 0, "some peer still
+    selects/accepts my broadcast".  The absolute-fraction reading
+    (target 0.5 = "most peers") misfires on single-winner rules like
+    krum, where even an honest row's selection fraction is ~1/candidates;
+    the any-peer default makes the equilibrium z exactly the defense's
+    empirical selection margin.
+    """
+    from murmura_tpu.attacks.alie import make_alie_attack
+
+    static = make_alie_attack(
+        num_nodes, attack_percentage, z=z, seed=seed, estimator=estimator
+    )
+    comp_idx = np.flatnonzero(static.compromised)
+    z0 = resolve_alie_z(num_nodes, len(comp_idx), z)
+    cap = float(z_cap) if z_cap is not None else max(4.0 * abs(z0), 4.0)
+    state_keys = ("atk_accept_ema", "atk_z")
+
+    def init_attack_state(n: int) -> Dict[str, np.ndarray]:
+        return {
+            "atk_z": np.full(n, z0, np.float32),
+            "atk_accept_ema": np.ones(n, np.float32),
+        }
+
+    def apply_adaptive(flat, compromised_mask, key, round_idx, state):
+        if flat.shape[0] != num_nodes or not len(comp_idx):
+            return flat  # per-node view: no population statistics here
+        mu, var = coalition_stats(flat, compromised_mask, estimator)
+        z_rows = state["atk_z"].astype(jnp.float32)[:, None]  # [N, 1]
+        malicious = (mu - z_rows * jnp.sqrt(var)).astype(flat.dtype)
+        return jnp.where(compromised_mask[:, None] > 0, malicious, flat)
+
+    def update_attack_state(state, accept, observed, compromised_mask):
+        upd = compromised_mask * observed
+        ema = _gated(
+            upd,
+            (1.0 - ema_beta) * state["atk_accept_ema"] + ema_beta * accept,
+            state["atk_accept_ema"],
+        )
+        # The step direction reads the ROUND's acceptance, not the EMA:
+        # an EMA > 0 test never flips back after a rejection streak
+        # (0.5^k stays positive), which would turn the walk into monotone
+        # escalation.  The EMA is carried smoothed telemetry the frontier
+        # summarizes, not the decision variable.
+        accepted = (accept > accept_target).astype(jnp.float32)
+        z_new = state["atk_z"] * jnp.where(accepted > 0, 1.0 + eta, 1.0 - eta)
+        z_new = jnp.clip(z_new, z_min, cap)
+        return {
+            "atk_accept_ema": ema,
+            "atk_z": _gated(upd, z_new, state["atk_z"]),
+        }
+
+    def strength_stats(state, compromised_mask):
+        return {
+            "atk_z": state["atk_z"] * compromised_mask,
+            "atk_accept_ema": state["atk_accept_ema"] * compromised_mask,
+        }
+
+    return AdaptiveAttack(
+        name="adaptive_alie",
+        compromised=static.compromised,
+        apply=static.apply,
+        trains_locally=static.trains_locally,
+        state_keys=state_keys,
+        init_attack_state=init_attack_state,
+        apply_adaptive=apply_adaptive,
+        update_attack_state=update_attack_state,
+        strength_stats=strength_stats,
+    )
+
+
+def make_bisection_attack(
+    inner: Attack,
+    scale_init: float = 1.0,
+    scale_max: float = 8.0,
+    growth: float = 2.0,
+    accept_target: float = 0.0,
+    ema_beta: float = 0.5,
+) -> AdaptiveAttack:
+    """Wrap ANY static broadcast attack into "largest strength still
+    accepted": the broadcast becomes ``own + scale * (attacked - own)``
+    with ``scale`` per-node carried state driven by a growth-then-
+    bisection probe.  While no rejection has been observed (``atk_hi``
+    still at its above-the-cap init sentinel) accepted rounds DOUBLE the
+    probe
+    (geometric growth finds the rejection region fast); once a rejection
+    pins the bracket, the probe bisects ``[atk_lo, atk_hi]`` — ``atk_lo``
+    converges to the defense's empirical breaking point from below, the
+    number `murmura frontier` charts against the MUR800 declared bound.
+
+    "Accepted" is a round's acceptance fraction STRICTLY above
+    ``accept_target`` (default 0: some peer selected/accepted the row —
+    the right reading for single-winner rules like krum, where even
+    honest rows win only ~1/candidates of receivers).
+
+    The wrapped attacker TRAINS LOCALLY (``Attack.trains_locally``),
+    unlike the frozen-model static attacks it wraps: a bisection around
+    a frozen-param broadcast is degenerate — distance filters reject the
+    *staleness* at any scale, so the bracket collapses to 0 and measures
+    nothing.  Training benignly and perturbing means scale -> 0 recovers
+    honest behavior exactly, and the bracket converges to the filter's
+    true perturbation margin.
+
+    Data-poisoning attacks have no broadcast perturbation to scale and
+    are rejected loudly (factories enforces this at config level too).
+    """
+    if inner.data_poison_fn is not None:
+        raise ValueError(
+            f"attack '{inner.name}' poisons data, not broadcasts — there "
+            "is no broadcast perturbation for the bisection wrapper to "
+            "scale"
+        )
+    if not scale_max > 0:
+        raise ValueError(f"scale_max must be > 0, got {scale_max}")
+    scale_init = float(min(scale_init, scale_max))
+    state_keys = ("atk_accept_ema", "atk_hi", "atk_lo", "atk_scale")
+
+    # atk_hi's "no rejection observed yet" sentinel sits strictly ABOVE
+    # scale_max: a real rejection at exactly scale_max must pin the
+    # bracket (hi = scale_max, growth phase over), which an init of
+    # scale_max itself cannot distinguish — the probe would stay wedged
+    # at the cap forever and atk_lo would understate the true margin by
+    # up to the growth factor.
+    hi_init = float(scale_max) * float(growth)
+
+    def init_attack_state(n: int) -> Dict[str, np.ndarray]:
+        return {
+            "atk_scale": np.full(n, scale_init, np.float32),
+            "atk_lo": np.zeros(n, np.float32),
+            "atk_hi": np.full(n, hi_init, np.float32),
+            "atk_accept_ema": np.ones(n, np.float32),
+        }
+
+    def apply_adaptive(flat, compromised_mask, key, round_idx, state):
+        base = inner.apply(flat, compromised_mask, key, round_idx)
+        scale = state["atk_scale"].astype(jnp.float32)[:, None]
+        f32 = flat.astype(jnp.float32)
+        return (
+            f32 + scale * (base.astype(jnp.float32) - f32)
+        ).astype(flat.dtype)
+
+    def update_attack_state(state, accept, observed, compromised_mask):
+        upd = compromised_mask * observed
+        scale, lo, hi = state["atk_scale"], state["atk_lo"], state["atk_hi"]
+        ema = _gated(
+            upd,
+            (1.0 - ema_beta) * state["atk_accept_ema"] + ema_beta * accept,
+            state["atk_accept_ema"],
+        )
+        accepted = (accept > accept_target).astype(jnp.float32)
+        lo_new = jnp.where(accepted > 0, jnp.maximum(lo, scale), lo)
+        hi_new = jnp.where(accepted > 0, hi, jnp.minimum(hi, scale))
+        # Strictly above scale_max <=> still the init sentinel <=> no
+        # rejection has ever been observed (a rejection sets hi to the
+        # probed scale, which min(scale_init, scale_max) caps).
+        growing = (hi_new > scale_max).astype(jnp.float32)
+        probe = jnp.where(
+            growing > 0,
+            jnp.minimum(scale * growth, scale_max),
+            0.5 * (lo_new + hi_new),
+        )
+        return {
+            "atk_accept_ema": ema,
+            "atk_scale": _gated(upd, probe, scale),
+            "atk_lo": _gated(upd, lo_new, lo),
+            "atk_hi": _gated(upd, hi_new, hi),
+        }
+
+    def strength_stats(state, compromised_mask):
+        return {
+            "atk_scale": state["atk_scale"] * compromised_mask,
+            "atk_lo": state["atk_lo"] * compromised_mask,
+            "atk_hi": state["atk_hi"] * compromised_mask,
+            "atk_accept_ema": state["atk_accept_ema"] * compromised_mask,
+        }
+
+    return AdaptiveAttack(
+        name=f"bisection_{inner.name}",
+        compromised=inner.compromised,
+        apply=inner.apply,
+        trains_locally=True,
+        state_keys=state_keys,
+        init_attack_state=init_attack_state,
+        apply_adaptive=apply_adaptive,
+        update_attack_state=update_attack_state,
+        strength_stats=strength_stats,
+    )
+
+
+# Adaptive attack builders the MUR1000 bijection sweeps: every factory
+# here must emit state keys drawn from — and jointly covering —
+# ATTACK_STATE_KEYS.  New adaptive attacks register here or fail MUR1000.
+def _probe_bisection() -> AdaptiveAttack:
+    from murmura_tpu.attacks.gaussian import make_gaussian_attack
+
+    return make_bisection_attack(
+        make_gaussian_attack(4, attack_percentage=0.25, noise_std=1.0)
+    )
+
+
+ADAPTIVE_ATTACKS: Dict[str, Callable[[], AdaptiveAttack]] = {
+    "adaptive_alie": lambda: make_adaptive_alie_attack(
+        4, attack_percentage=0.25
+    ),
+    "bisection": _probe_bisection,
+}

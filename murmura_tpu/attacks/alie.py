@@ -38,7 +38,7 @@ from typing import Optional
 import jax.numpy as jnp
 import numpy as np
 
-from murmura_tpu.attacks.base import Attack, honest_mean, select_compromised
+from murmura_tpu.attacks.base import Attack, select_compromised
 
 
 def alie_z_max(num_nodes: int, num_compromised: int) -> float:
@@ -86,14 +86,32 @@ def make_alie_attack(
     attack_percentage: float,
     z: Optional[float] = None,
     seed: int = 42,
+    estimator: str = "omniscient",
 ) -> Attack:
+    """``estimator`` selects whose rows the mu/sigma statistics come from
+    on the jitted backends (``attack.params.estimator``):
+
+    - ``"omniscient"`` (default, the historical behavior): the TRUE
+      honest rows — strictly STRONGER than the paper's construction
+      (module docstring caveat applies to results labeled "ALIE");
+    - ``"coalition"``: the compromised rows' own benign-trained states
+      only — Baruch et al.'s actual estimator, matching the ZMQ
+      backend's ``_colluding_state``.  The colluders must therefore RUN
+      local training (``trains_locally``, like label_flip) so their rows
+      hold benign gradients rather than frozen init params.
+    """
+    if estimator not in ("omniscient", "coalition"):
+        raise ValueError(
+            f"ALIE estimator must be 'omniscient' or 'coalition', "
+            f"got {estimator!r}"
+        )
     compromised = select_compromised(num_nodes, attack_percentage, seed)
     comp_idx = np.flatnonzero(compromised)
     z_val = resolve_alie_z(num_nodes, len(comp_idx), z)
 
     def apply(flat, compromised_mask, key, round_idx):
         if flat.shape[0] != num_nodes or not len(comp_idx):
-            # Per-node view: no honest-population statistics exist here.
+            # Per-node view: no population statistics exist here.
             # The ZMQ backend never routes ALIE through this function —
             # NodeProcess._execute_round branches to the coalition
             # estimator (_colluding_state) instead, and the factory
@@ -101,18 +119,20 @@ def make_alie_attack(
             # (alie+dmtt).  Reachable only from direct library use; pass
             # through rather than fabricate a non-colluding variant.
             return flat
-        # Honest-population coordinate statistics in f32 (base.honest_mean;
-        # the variance shares its mask/count for the same bf16-quantization
-        # reason).
-        f32 = flat.astype(jnp.float32)
-        hm = (1.0 - compromised_mask.astype(jnp.float32))[:, None]  # [N, 1]
-        cnt = jnp.maximum(hm.sum(), 1.0)
-        mu = honest_mean(flat, compromised_mask)
-        var = (jnp.square(f32 - mu) * hm).sum(axis=0, keepdims=True) / cnt
+        # Coordinate statistics in f32 (base.honest_mean; the variance
+        # shares its mask/count for the same bf16-quantization reason).
+        from murmura_tpu.attacks.adaptive import coalition_stats
+
+        mu, var = coalition_stats(flat, compromised_mask, estimator)
         malicious = (mu - z_val * jnp.sqrt(var)).astype(flat.dtype)  # [1, P]
         # Elementwise select, not scatter (same layout rationale as the
         # gaussian attack's one-hot rewrite): every compromised row
         # broadcasts the identical colluding vector.
         return jnp.where(compromised_mask[:, None] > 0, malicious, flat)
 
-    return Attack(name="alie", compromised=compromised, apply=apply)
+    return Attack(
+        name="alie",
+        compromised=compromised,
+        apply=apply,
+        trains_locally=(estimator == "coalition"),
+    )

@@ -7,6 +7,7 @@ Commands: ``run`` (simulation / tpu / distributed by config.backend),
 
 import json
 from pathlib import Path
+from typing import Optional
 
 import click
 from rich.console import Console
@@ -518,6 +519,63 @@ def sweep(config_path: Path, seeds, verbose, output, device, checkpoint_dir,
     )
 
 
+@app.command()
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--output", "-o", type=click.Path(path_type=Path),
+              default=Path("frontier.json"), show_default=True,
+              help="Write the frontier artifact (rule x attack x strength "
+                   "curves + breaking points vs declared bounds) here")
+@click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
+              help="Force the JAX platform")
+@click.option("--require-tpu", is_flag=True, default=False,
+              help="Abort loudly unless the default JAX backend is a TPU")
+def frontier(config_path: Path, output, device, require_tpu):
+    """Adversarial breaking-point search at gang speed
+    (docs/ROBUSTNESS.md "The robustness frontier").
+
+    For every (rule x adaptive attack x topology) cell of the config's
+    ``frontier:`` grid (defaults cover krum/median/trimmed_mean/balance
+    x adaptive-ALIE/bisection-gaussian x dense/sparse-exponential), runs
+    an attack-strength x seed gang bucket with an outer successive-
+    halving loop that re-aims the grid at the honest-accuracy cliff
+    WITHOUT recompiling, then writes ``frontier.json`` charting each
+    rule's empirical breaking point next to its MUR800 declared
+    influence bound.  Render with `murmura report --frontier`.
+    """
+    if device is not None:
+        # Must land before anything initializes the XLA backend.
+        import jax
+
+        jax.config.update("jax_platforms", device)
+    config = _load_config_or_die(config_path)
+    _enforce_require_tpu(config, require_tpu)
+    from murmura_tpu.frontier import run_frontier, write_frontier
+    from murmura_tpu.utils.factories import ConfigError
+
+    f = config.frontier
+    grid_desc = (
+        f"{f.rules} x {f.attacks} x {f.topologies}" if f is not None
+        else "default grid"
+    )
+    console.print(
+        f"[bold cyan]murmura_tpu[/bold cyan] frontier "
+        f"[bold]{config.experiment.name}[/bold] "
+        f"(nodes={config.topology.num_nodes}, {escape(grid_desc)})"
+    )
+    try:
+        artifact = run_frontier(
+            config, progress=lambda s: console.print(f"[dim]{escape(s)}[/dim]")
+        )
+    except ConfigError as e:
+        _die_config_error(e)
+    path = write_frontier(artifact, output)
+    console.print(f"Frontier artifact written to [bold]{path}[/bold]")
+    from murmura_tpu.telemetry.report import render_frontier
+
+    render_frontier(artifact, console=console)
+    return artifact
+
+
 @app.command("run-node")
 @click.argument("config_path", type=click.Path(exists=True, path_type=Path))
 @click.option("--node-id", type=int, required=True, help="This worker's node id")
@@ -574,6 +632,14 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "explicit PATHS are given.",
 )
 @click.option(
+    "--adaptive/--no-adaptive", "adaptive", default=None,
+    help="Run the adaptive-adversary contracts (MUR1000-1003: attack-"
+         "state registry bijection, recompile-free adaptation, "
+         "collective-inventory parity, feedback taint containment).  "
+         "Compiles and runs tiny programs (~1 min on CPU).  Default: on "
+         "for the package check, off when explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary records) as JSON "
          "lines for editor/CI annotation instead of the greppable text "
@@ -584,7 +650,8 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
     help="Re-measure the AOT cost grid and rewrite analysis/BUDGETS.json; "
          "review the diff as perf history.",
 )
-def check(paths, contracts, ir, flow, durability, as_json, update_budgets):
+def check(paths, contracts, ir, flow, durability, adaptive, as_json,
+          update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -594,8 +661,9 @@ def check(paths, contracts, ir, flow, durability, as_json, update_budgets):
     the jaxpr/HLO IR contracts plus committed cost budgets (MUR200-206),
     the jaxpr dataflow contracts (MUR800-804: per-neighbor Byzantine
     influence bounds, NaN/attack scrub dominance, zero-free denominators),
-    and the durability contracts (MUR900 snapshot completeness via
-    --contracts; MUR901/902 resume determinism via --durability).
+    the durability contracts (MUR900 snapshot completeness via
+    --contracts; MUR901/902 resume determinism via --durability), and the
+    adaptive-adversary contracts (MUR1000-1003 via --adaptive).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -617,7 +685,7 @@ def check(paths, contracts, ir, flow, durability, as_json, update_budgets):
 
     findings, records = run_check_detailed(
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
-        durability=durability,
+        durability=durability, adaptive=adaptive,
     )
     if as_json:
         out = format_findings_json(findings, records)
@@ -638,23 +706,61 @@ def check(paths, contracts, ir, flow, durability, as_json, update_budgets):
 
 @app.command()
 @click.argument(
-    "run_dir", type=click.Path(exists=True, file_okay=False, path_type=Path)
+    "run_dir", required=False, default=None,
+    type=click.Path(exists=True, file_okay=False, path_type=Path),
+)
+@click.option(
+    "--frontier", "frontier_path", default=None,
+    type=click.Path(exists=True, dir_okay=False, path_type=Path),
+    help="Render a frontier.json artifact (`murmura frontier`) instead of "
+         "a telemetry run directory: empirical breaking point vs MUR800 "
+         "declared influence bound per rule x attack x topology cell, "
+         "plus each cell's honest-accuracy curve over attack strength.",
 )
 @click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit the report as one JSON object (machine-readable; the same "
          "dict the tables render) instead of rich tables.",
 )
-def report(run_dir: Path, as_json: bool):
-    """Render a telemetry run directory (manifest.json + events.jsonl).
+def report(run_dir: Optional[Path], frontier_path: Optional[Path],
+           as_json: bool):
+    """Render a telemetry run directory (manifest.json + events.jsonl),
+    or — with ``--frontier`` — a frontier artifact.
 
     Works on any producer's output — a `murmura_tpu run` with
     ``telemetry.enabled``, a distributed run's Monitor-folded manifest, or
     a bench artifact (bench.py / bench_breakdown.py).  Sections: accuracy,
     robustness/rule statistics, time breakdown by dispatch mode,
     checkpoints, device memory, per-node audit taps (e.g. krum rejection
-    counts), distributed counters.  See docs/OBSERVABILITY.md.
+    counts), distributed counters.  See docs/OBSERVABILITY.md;
+    docs/ROBUSTNESS.md for reading the frontier tables.
     """
+    if frontier_path is not None:
+        from murmura_tpu.frontier import (
+            frontier_break_summary,
+            load_frontier,
+        )
+        from murmura_tpu.telemetry.report import render_frontier
+
+        try:
+            artifact = load_frontier(frontier_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            console.print(f"[bold red]{escape(str(e))}[/bold red]")
+            raise SystemExit(1)
+        if as_json:
+            click.echo(json.dumps({
+                "grid": artifact.get("grid"),
+                "summary": frontier_break_summary(artifact),
+            }))
+        else:
+            render_frontier(artifact, console=console)
+        return
+    if run_dir is None:
+        console.print(
+            "[bold red]murmura report needs a RUN_DIR (or "
+            "--frontier <frontier.json>)[/bold red]"
+        )
+        raise SystemExit(1)
     from murmura_tpu.telemetry.report import build_report, render_report
 
     try:

@@ -57,6 +57,75 @@ class AggregationConfig(_Strict):
     )
 
 
+class AdaptiveAttackConfig(_Strict):
+    """In-jit closed-loop attack adaptation (attacks/adaptive.py;
+    docs/ROBUSTNESS.md "Adaptive adversaries").
+
+    With ``enabled``, the configured attack tunes its own strength each
+    round against the audit-tap acceptance signal inside the compiled
+    round program: ``type: alie`` becomes adaptive ALIE (the deviation
+    factor z walks the defense's selection margin); every other
+    broadcast attack (gaussian/directed_deviation/ipm) is wrapped in the
+    generic scale bisection ("largest strength still accepted").  The
+    adaptation state rides ``agg_state`` under the reserved
+    ATTACK_STATE_KEYS, so durability snapshots resume a mid-bisection
+    attacker byte-identically (MUR901's adaptive cell).  Default off =>
+    byte-identical programs and histories.
+    """
+
+    enabled: bool = Field(
+        default=False, description="Enable closed-loop adaptation"
+    )
+    ema_beta: float = Field(
+        default=0.5, gt=0.0, le=1.0,
+        description="Acceptance-EMA smoothing factor",
+    )
+    accept_target: float = Field(
+        default=0.0, ge=0.0, lt=1.0,
+        description=(
+            "Acceptance fraction STRICTLY above which a round counts as "
+            "accepted (0 = some peer selected/accepted the row — the "
+            "right reading for single-winner rules like krum)"
+        ),
+    )
+    eta: float = Field(
+        default=0.25, gt=0.0, lt=1.0,
+        description="Adaptive-ALIE multiplicative z step (1 +/- eta)",
+    )
+    scale_init: float = Field(
+        default=1.0, gt=0.0,
+        description="Bisection wrapper: first probed strength multiplier",
+    )
+    scale_max: float = Field(
+        default=8.0, gt=0.0,
+        description="Bisection wrapper: strength cap / growth-phase limit",
+    )
+    growth: float = Field(
+        default=2.0, gt=1.0,
+        description=(
+            "Bisection wrapper: growth factor while no rejection has "
+            "been observed"
+        ),
+    )
+    z_min: float = Field(
+        default=0.05, gt=0.0, description="Adaptive-ALIE z floor"
+    )
+    z_cap: Optional[float] = Field(
+        default=None, gt=0.0,
+        description="Adaptive-ALIE z ceiling (default: max(4*z0, 4))",
+    )
+
+    @model_validator(mode="after")
+    def _bracket_sane(self):
+        if self.scale_init > self.scale_max:
+            raise ValueError(
+                f"adaptive.scale_init={self.scale_init} > "
+                f"scale_max={self.scale_max} — the first probe would "
+                "start outside the bracket"
+            )
+        return self
+
+
 class AttackConfig(_Strict):
     """Byzantine attack scenario (reference: murmura/config/schema.py:84-94)."""
 
@@ -70,6 +139,13 @@ class AttackConfig(_Strict):
     percentage: float = Field(default=0.0, description="Fraction of nodes compromised")
     params: Dict[str, Any] = Field(
         default_factory=dict, description="Attack-specific parameters"
+    )
+    adaptive: AdaptiveAttackConfig = Field(
+        default_factory=AdaptiveAttackConfig,
+        description=(
+            "In-jit closed-loop adaptation (docs/ROBUSTNESS.md); default "
+            "off => byte-identical to no adaptive block"
+        ),
     )
 
 
@@ -545,6 +621,98 @@ class SweepConfig(_Strict):
         return self
 
 
+class FrontierConfig(_Strict):
+    """`murmura frontier <yaml>`: gang-powered adversarial search for each
+    rule's empirical breaking point (docs/ROBUSTNESS.md "The robustness
+    frontier").
+
+    For every (rule x attack x topology) cell the driver stacks an
+    attack-strength x seed grid into ONE compile-compatible gang bucket
+    (per-member ``attack_scale`` — the sweep plumbing — padded to the
+    next power of two), trains it, and runs an outer successive-halving
+    loop that re-aims the strength grid at the honest-accuracy cliff
+    WITHOUT recompiling (strengths are traced inputs; the gang is reset
+    value-only between stages).  The committed ``frontier.json`` charts
+    honest accuracy vs strength per cell plus each bounded rule's MUR800
+    declared influence bound next to its empirical breaking point.
+    """
+
+    rules: List[str] = Field(
+        default=["krum", "median", "trimmed_mean", "balance"],
+        description="Aggregation rules to chart",
+    )
+    attacks: List[Literal["alie", "gaussian"]] = Field(
+        default=["alie", "gaussian"],
+        description=(
+            "Adaptive attacks per cell: 'alie' = adaptive ALIE, "
+            "'gaussian' = bisection-wrapped gaussian"
+        ),
+    )
+    topologies: List[Literal["dense", "sparse"]] = Field(
+        default=["dense", "sparse"],
+        description=(
+            "'dense' = the config's own (dense) topology; 'sparse' = the "
+            "degree-log(N) exponential graph (arXiv:2110.13363)"
+        ),
+    )
+    strength_lo: float = Field(
+        default=0.25, gt=0.0,
+        description="Initial strength grid lower edge (attack_scale units)",
+    )
+    strength_hi: float = Field(
+        default=4.0, gt=0.0,
+        description="Initial strength grid upper edge",
+    )
+    points: int = Field(
+        default=4, ge=2,
+        description=(
+            "Nonzero strengths per stage (a 0-strength benign reference "
+            "member is always added)"
+        ),
+    )
+    seeds: Optional[List[int]] = Field(
+        default=None,
+        description="Member seeds per strength (default: [experiment.seed])",
+    )
+    stages: int = Field(
+        default=2, ge=1,
+        description="Successive-halving refinement stages per cell",
+    )
+    rounds: Optional[int] = Field(
+        default=None, ge=1,
+        description="Training rounds per stage (default: experiment.rounds)",
+    )
+    break_fraction: float = Field(
+        default=0.5, gt=0.0, le=1.0,
+        description=(
+            "A strength is 'broken' when mean honest accuracy falls "
+            "below break_fraction * the 0-strength benign accuracy"
+        ),
+    )
+
+    @model_validator(mode="after")
+    def _grid_sane(self):
+        if self.strength_lo >= self.strength_hi:
+            raise ValueError(
+                f"frontier.strength_lo={self.strength_lo} must be < "
+                f"strength_hi={self.strength_hi}"
+            )
+        for fieldname in ("rules", "attacks", "topologies"):
+            vals = getattr(self, fieldname)
+            if not vals:
+                raise ValueError(f"frontier.{fieldname} must be non-empty")
+            if len(vals) != len(set(vals)):
+                raise ValueError(
+                    f"frontier.{fieldname} has duplicates: {vals}"
+                )
+        if self.seeds is not None:
+            if not self.seeds:
+                raise ValueError("frontier.seeds must be non-empty")
+            if len(self.seeds) != len(set(self.seeds)):
+                raise ValueError("frontier.seeds must be distinct")
+        return self
+
+
 class TrainingConfig(_Strict):
     """Local training hyperparameters (reference: murmura/config/schema.py:142-150)."""
 
@@ -793,6 +961,50 @@ class Config(_Strict):
             "default off => byte-identical to no durability block"
         ),
     )
+    frontier: Optional[FrontierConfig] = Field(
+        default=None,
+        description=(
+            "`murmura frontier` adversarial-search grid (rule x adaptive "
+            "attack x topology breaking-point curves; docs/ROBUSTNESS.md); "
+            "absent => byte-identical behavior (only the frontier command "
+            "reads it)"
+        ),
+    )
+
+    @model_validator(mode="after")
+    def _adaptive_attack_is_wirable(self):
+        a = self.attack
+        if not a.adaptive.enabled:
+            return self
+        if not a.enabled or a.type is None:
+            # Same fail-loud discipline as the telemetry sub-settings: an
+            # adaptive block without an attack would silently run benign.
+            raise ValueError(
+                "attack.adaptive.enabled requires attack.enabled: true "
+                "and an attack.type — there is no attack to adapt"
+            )
+        if a.type in ("label_flip", "topology_liar"):
+            raise ValueError(
+                f"attack.adaptive does not support attack.type "
+                f"'{a.type}': label_flip poisons data (no broadcast "
+                "perturbation to scale) and topology_liar's claims "
+                "channel is not modeled by the adaptation state; use "
+                "gaussian/directed_deviation/ipm (bisection) or alie "
+                "(adaptive ALIE)"
+            )
+        if self.backend == "distributed":
+            raise ValueError(
+                "adaptive attacks close the feedback loop inside the "
+                "jitted round program; backend: distributed trains in "
+                "per-node OS processes — use backend: simulation or tpu"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "adaptive attacks do not compose with dmtt (the claims "
+                "channel is a second feedback path the adaptation state "
+                "does not model)"
+            )
+        return self
 
     @model_validator(mode="after")
     def _telemetry_requires_enabled(self):

@@ -48,6 +48,7 @@ import numpy as np
 from murmura_tpu.core.network import (
     effective_adjacency,
     effective_alive,
+    effective_edge_mask,
     empty_history,
     record_round_metrics,
     sanitizer_scope,
@@ -243,10 +244,30 @@ class GangNetwork:
         recompile_guard: bool = False,
         transfer_guard: bool = False,
         telemetry_writers: Optional[Sequence] = None,
+        retain_init: bool = False,
     ):
         if len(member_programs) != len(members):
             raise ValueError("one RoundProgram per member required")
         _check_member_compatible(member_programs, members)
+        if program.sparse:
+            from murmura_tpu.topology.sparse import SparseTopology
+
+            # Sparse exchange mode (topology/sparse.py): the gang's adj
+            # input is the member-shared [k, N] edge mask, exactly like a
+            # single run's — it rides in_axes=None so nothing here is
+            # mode-specific beyond the per-round mask source below.  A
+            # node-SHARDED gang mesh is still rejected at the factory
+            # (the [k, N] layout needs edge_mask_sharding plumbing).
+            if not isinstance(topology, SparseTopology):
+                raise ValueError(
+                    "the gang's round program was built with "
+                    "sparse_offsets but the topology is not a "
+                    "SparseTopology"
+                )
+            if mobility is not None:
+                raise ValueError(
+                    "sparse exchange mode does not compose with mobility"
+                )
         self.program = program
         self.members = list(members)
         self.gang_size = len(members)
@@ -292,12 +313,19 @@ class GangNetwork:
         stack = lambda get: _stack_trees(  # noqa: E731
             [get(p) for p in member_programs], self._indices
         )
-        self.params = jax.tree_util.tree_map(
-            jnp.asarray, stack(lambda p: p.init_params)
-        )
+        init_params_host = stack(lambda p: p.init_params)
+        init_agg_host = stack(lambda p: p.init_agg_state)
+        # retain_init keeps the stacked host-side init arrays alive so
+        # reset_run() can rebuild fresh device state without the member
+        # programs (the frontier's stage loop — value-only resets over one
+        # warm compiled program).  Off by default: normal sweeps should
+        # not hold a second host copy of [B, N, P] params.
+        self._init_params_host = init_params_host if retain_init else None
+        self._init_agg_host = init_agg_host if retain_init else None
+        self._base_lr = base_lr
+        self.params = jax.tree_util.tree_map(jnp.asarray, init_params_host)
         self.agg_state = {
-            k: jnp.asarray(v)
-            for k, v in stack(lambda p: p.init_agg_state).items()
+            k: jnp.asarray(v) for k, v in init_agg_host.items()
         }
         data = stack(lambda p: p.data_arrays)
         # Per-member hyperparameter inputs overwrite the stacked defaults.
@@ -418,7 +446,13 @@ class GangNetwork:
 
     def _adjacency_for_round(self, round_idx: int) -> np.ndarray:
         """Member-shared per-round adjacency (the Network helper — the
-        topology/mobility/fault seeds are member-independent)."""
+        topology/mobility/fault seeds are member-independent).  Sparse
+        programs take the [k, N] edge mask where dense ones take the
+        [N, N] matrix, exactly like a single run's dispatch loop."""
+        if self.program.sparse:
+            return effective_edge_mask(
+                self.topology, self.fault_schedule, round_idx
+            )
         return effective_adjacency(
             self.topology, self.mobility, self.fault_schedule, round_idx
         )
@@ -736,6 +770,81 @@ class GangNetwork:
         if active is not None and len(active) == self.gang_size:
             self.member_active = [bool(a) for a in active]
 
+    def reset_run(self, members: Sequence[GangMember]) -> None:
+        """Value-only reset for a fresh run of the SAME gang shape with
+        new traced-scalar hyperparameters — the `murmura frontier` stage
+        loop (frontier.py): params/agg_state/RNG/histories return to
+        round 0 while the warm compiled programs (and their jit caches)
+        are untouched, so the next train() costs ZERO recompiles.
+
+        Constraints, each fail-loud: the gang must have been built with
+        ``retain_init=True`` (the stacked host init arrays are the reset
+        source), the new member list must be slot-for-slot the same
+        seeds (data shards and init params were built per ORIGINAL seed
+        — changing a seed silently trains on the wrong shard), and only
+        traced-input overrides (lr / attack_scale) may differ.
+        """
+        if self._init_params_host is None:
+            raise ValueError(
+                "reset_run() needs the gang built with retain_init=True "
+                "(the stacked host init arrays are the reset source)"
+            )
+        members = list(members)
+        if len(members) != self.gang_size:
+            raise ValueError(
+                f"reset_run got {len(members)} members for a gang of "
+                f"{self.gang_size} — the bucket shape must not change "
+                "(that is the whole point of the reset)"
+            )
+        for i, (old, new) in enumerate(zip(self.members, members)):
+            if new.seed != old.seed:
+                raise ValueError(
+                    f"reset_run member {i} changes seed {old.seed} -> "
+                    f"{new.seed} — data shards and init params were "
+                    "built per original seed; only lr/attack_scale may "
+                    "vary across stages"
+                )
+        labels = [m.label for m in members]
+        if len(labels) != len(set(labels)):
+            raise ValueError(
+                f"reset_run members are not distinct (labels: {labels})"
+            )
+        self.members = members
+        if "lr" in self.program.hp_inputs:
+            self._data["hp_lr"] = jnp.asarray(np.asarray(
+                [
+                    members[i].lr if members[i].lr is not None
+                    else self._base_lr
+                    for i in self._indices
+                ],
+                np.float32,
+            ))
+        if "attack_scale" in self.program.hp_inputs:
+            self._data["hp_attack_scale"] = jnp.asarray(np.asarray(
+                [
+                    members[i].attack_scale
+                    if members[i].attack_scale is not None
+                    else 1.0
+                    for i in self._indices
+                ],
+                np.float32,
+            ))
+        self.params = jax.tree_util.tree_map(
+            jnp.asarray, self._init_params_host
+        )
+        self.agg_state = {
+            k: jnp.asarray(v) for k, v in self._init_agg_host.items()
+        }
+        self._rng = jnp.stack(
+            [jax.random.PRNGKey(members[i].seed) for i in self._indices]
+        )
+        self._place_resident_state()
+        self.histories = [empty_history() for _ in range(self.gang_size)]
+        self._last_stats = [{} for _ in range(self.gang_size)]
+        self.round_times = []
+        self.current_round = 0
+        self.member_active = [True] * self.gang_size
+
     def freeze_member(self, member: int, reason: str) -> None:
         """Gracefully degrade one member's lane: recording stops (its
         history freezes at the current round), survivors continue, and
@@ -778,9 +887,11 @@ class GangNetwork:
         if any(t is not None for t in self.telemetry):
             # The effective adjacency is member-shared — compute its
             # in-degree once per recorded round, not once per member.
-            in_deg = np.asarray(
-                self._adjacency_for_round(round_num - 1)
-            ).sum(axis=0)
+            mask = np.asarray(self._adjacency_for_round(round_num - 1))
+            if self.program.sparse:
+                in_deg = self.topology.in_degree_from_edge_mask(mask)
+            else:
+                in_deg = mask.sum(axis=0)
         for s in range(self.gang_size):
             if not self.member_active[s]:
                 # Frozen lane (freeze_member): the member's history stays

@@ -35,6 +35,7 @@ import numpy as np
 
 from murmura_tpu.aggregation.base import AggContext, AggregatorDef
 from murmura_tpu.aggregation.probe import combined_probe_metric, pairwise_probe_eval
+from murmura_tpu.attacks.adaptive import AdaptiveAttack, acceptance_feedback
 from murmura_tpu.attacks.base import Attack
 from murmura_tpu.data.base import FederatedArrays
 from murmura_tpu.faults.schedule import FaultSpec
@@ -109,6 +110,12 @@ class RoundProgram:
     # feedback.  None (default) => the traced program is byte-identical to
     # pre-compression builds.
     compression: Optional[CompressionSpec] = None
+    # Closed-loop adaptive attack (attacks/adaptive.py;
+    # docs/ROBUSTNESS.md): the attack's adaptation state rides
+    # ``agg_state`` under ATTACK_STATE_KEYS and each round's acceptance
+    # taps update it in-jit.  False (default) => the traced program is
+    # byte-identical to pre-adaptive builds.
+    adaptive_attack: bool = False
 
     @property
     def sparse(self) -> bool:
@@ -206,6 +213,23 @@ def build_round_program(
             "compressed probe sweep would verify against different models "
             "than the rules aggregate)"
         )
+
+    # Closed-loop adaptive attack (attacks/adaptive.py): the attacker's
+    # adaptation state rides agg_state (ATTACK_STATE_KEYS) and the audit
+    # taps ARE its feedback channel, so tapping is forced on — taps are
+    # collective- and recompile-inert by contract (MUR400/402), so this
+    # changes metrics surface, never communication.  attack=None or a
+    # static attack leaves every adaptive branch below untaken: the
+    # traced program is byte-identical to pre-adaptive builds.
+    adaptive = isinstance(attack, AdaptiveAttack)
+    if adaptive:
+        if dmtt is not None:
+            raise ValueError(
+                "adaptive attacks do not compose with DMTT (the claims "
+                "channel is a second feedback path the adaptation state "
+                "does not model)"
+            )
+        audit_taps = True
 
     def _sender_view(vec):  # murmura: traced
         """[k, N] sender-side view of a [N] node flag: row j holds
@@ -500,9 +524,22 @@ def build_round_program(
             # Cast back: float32 attack noise must not promote the exchanged
             # [N, P] tensor when params are stored bfloat16 (tpu.param_dtype).
             with jax.named_scope("murmura.exchange"):
-                bcast = attack_apply(
-                    own_flat, compromised, attack_key, round_idx
-                ).astype(own_flat.dtype)
+                if adaptive:
+                    # Closed-loop attack: last round's adaptation state
+                    # (carried in agg_state under ATTACK_STATE_KEYS — the
+                    # feedback update below writes the next round's) sets
+                    # this round's strength per compromised row.
+                    attack_state = {
+                        k: agg_state[k] for k in attack.state_keys
+                    }
+                    bcast = attack.apply_adaptive(
+                        own_flat, compromised, attack_key, round_idx,
+                        attack_state,
+                    ).astype(own_flat.dtype)
+                else:
+                    bcast = attack_apply(
+                        own_flat, compromised, attack_key, round_idx
+                    ).astype(own_flat.dtype)
             if "attack_scale" in hp_inputs:
                 # Per-member attack intensity (gang sweeps): scale the
                 # perturbation the attack added to the broadcast.  For
@@ -597,15 +634,38 @@ def build_round_program(
             step_ctx = dataclasses.replace(step_ctx, probe_cross=cross)
 
         # 3. adjacency-masked aggregation (network.py:121-139)
+        reserved = set(DMTT_STATE_KEYS) | set(COMPRESS_STATE_KEYS)
+        if adaptive:
+            reserved |= set(attack.state_keys)
         rule_state = {
-            k: v for k, v in agg_state.items()
-            if k not in DMTT_STATE_KEYS and k not in COMPRESS_STATE_KEYS
+            k: v for k, v in agg_state.items() if k not in reserved
         }
         with jax.named_scope("murmura.aggregate"):
             new_flat, rule_state, agg_stats = agg.aggregate(
                 own_flat, bcast, adj, round_idx, rule_state, step_ctx
             )
         agg_state = {**agg_state, **rule_state}
+
+        # 3b. adaptive-attack feedback (attacks/adaptive.py): the attacker
+        # reads the acceptance taps the rule just emitted for its own rows
+        # (scrub/quarantine flags fold in as rejections; dead rows are not
+        # observations) and writes the next round's strength back into its
+        # ATTACK_STATE_KEYS slice of agg_state.  Everything is elementwise
+        # over node-local rows — the feedback path adds no collectives and
+        # no recompiles (MUR1001/1002, analysis/adaptive.py).
+        attack_round_stats = {}
+        if adaptive:
+            accept, observed = acceptance_feedback(
+                agg_stats, fault_stats, _in_degree(adj), alive
+            )
+            attack_state = attack.update_attack_state(
+                attack_state, accept, observed, compromised
+            )
+            agg_state = {**agg_state, **attack_state}
+            attack_round_stats = dict(
+                attack.strength_stats(attack_state, compromised)
+            )
+            attack_round_stats["atk_accept"] = accept * compromised
 
         if alive is not None:
             # Zero alive neighbors (everyone crashed/dropped/straggled)
@@ -629,6 +689,7 @@ def build_round_program(
         metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
         metrics.update({f"agg_{k}": v for k, v in fault_stats.items()})
         metrics.update({f"agg_{k}": v for k, v in compress_stats.items()})
+        metrics.update({f"agg_{k}": v for k, v in attack_round_stats.items()})
         return params, agg_state, metrics
 
     if faults is None:
@@ -671,6 +732,23 @@ def build_round_program(
         init_agg_state.update(
             init_compress_state(compression, init_flat, init_flat.dtype)
         )
+    if adaptive:
+        # Adaptation state rides agg_state under the attack's reserved
+        # ATTACK_STATE_KEYS slice — same shapes/dtypes every round, so the
+        # scan carry, gang vmap, donation aliases and durability snapshots
+        # all hold without special cases (the COMPRESS_STATE_KEYS story).
+        clash = set(attack.state_keys) & set(init_agg_state)
+        if clash:
+            raise ValueError(
+                f"aggregator '{agg.name}' carries state keys "
+                f"{sorted(clash)} reserved for the adaptive attack"
+            )
+        init_agg_state.update(
+            {
+                k: np.asarray(v)
+                for k, v in attack.init_attack_state(n).items()
+            }
+        )
 
     return RoundProgram(
         train_step=train_round,
@@ -685,6 +763,7 @@ def build_round_program(
         hp_inputs=hp_inputs,
         sparse_offsets=sparse_offsets,
         compression=compression,
+        adaptive_attack=adaptive,
     )
 
 

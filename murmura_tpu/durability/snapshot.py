@@ -75,6 +75,7 @@ SNAPSHOT_BASE_SECTIONS: Tuple[str, ...] = (
 # ``*_STATE_KEYS`` assignments by AST scan and fails the check when one
 # is missing here (or when an entry here no longer resolves).
 RESERVED_AGG_STATE_KEY_GROUPS: Dict[str, str] = {
+    "ATTACK_STATE_KEYS": "murmura_tpu.attacks.adaptive",
     "COMPRESS_STATE_KEYS": "murmura_tpu.ops.compress",
     "DMTT_STATE_KEYS": "murmura_tpu.core.rounds",
 }

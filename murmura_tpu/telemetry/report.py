@@ -322,3 +322,114 @@ def render_report(run_dir, console=None) -> Dict[str, Any]:
             "dropped[/yellow]"
         )
     return report
+
+
+# ----------------------------------------------------------------------
+# frontier rendering (`murmura report --frontier`; docs/ROBUSTNESS.md
+# "The robustness frontier")
+
+
+def _bar(frac: float, width: int = 16) -> str:
+    """Accuracy-fraction bar for the curve rows (unicode blocks)."""
+    if not math.isfinite(frac):
+        return "?" * width
+    filled = int(round(max(0.0, min(1.0, frac)) * width))
+    return "█" * filled + "·" * (width - filled)
+
+
+def render_frontier(artifact: Dict[str, Any], console=None) -> None:
+    """Render a ``frontier.json`` artifact (murmura_tpu/frontier.py): one
+    summary table of empirical breaking point vs MUR800 declared bound
+    per (rule x attack x topology) cell, then each cell's honest-accuracy
+    curve over attack strength.
+
+    The two columns to read together: ``declared`` is what the flow
+    analyzer PROVES the rule can admit per coordinate (`murmura check
+    --flow`, MUR800); ``broken at`` is where a closed-loop adversary
+    actually pushed the rule off its honest-accuracy cliff.  A bounded
+    rule breaking at low strength is a robustness gap the static bound
+    cannot see; an unbounded rule holding to high strength is averaging
+    luck, not a guarantee.
+    """
+    from rich.console import Console
+    from rich.table import Table
+
+    from murmura_tpu.frontier import frontier_break_summary
+
+    console = console or Console()
+    grid = artifact.get("grid") or {}
+    console.print(
+        f"[bold cyan]murmura frontier[/bold cyan] — "
+        f"[bold]{artifact.get('experiment', '?')}[/bold] "
+        f"(nodes={grid.get('num_nodes', '?')}, "
+        f"rounds={grid.get('rounds', '?')}, seeds={grid.get('seeds', '?')}, "
+        f"break < {grid.get('break_fraction', '?')} x benign)"
+    )
+    t = Table(title="Breaking point vs declared influence bound (per cell)")
+    t.add_column("rule", style="cyan")
+    t.add_column("attack")
+    t.add_column("topology")
+    t.add_column("deg", justify="right")
+    t.add_column("benign acc", justify="right")
+    t.add_column("held ≤", justify="right")
+    t.add_column("broken at", justify="right")
+    t.add_column("declared (MUR800)")
+    t.add_column("compiles", justify="right")
+    for row in frontier_break_summary(artifact):
+        held = row["last_held"]
+        broken = row["first_broken"]
+        kind = row["declared_kind"]
+        # Compact contract cell; the full InfluenceDecl.describe() text
+        # stays in the artifact's declared_influence payload.
+        declared = (
+            "undeclared" if kind is None
+            else f"bounded ≤ {row['declared_bound']}" if kind == "bounded"
+            else str(kind)
+        )
+        t.add_row(
+            str(row["rule"]), str(row["attack"]), str(row["topology"]),
+            str(row["degree"]), _fmt(row["benign_accuracy"], 3),
+            "-" if held is None else f"{held:.3g}",
+            "[bold red]never[/bold red]" if broken is None
+            else f"[bold]{broken:.3g}[/bold]",
+            declared,
+            str(row["compiles"]),
+        )
+    console.print(t)
+    for cell in artifact.get("cells", []):
+        benign = cell.get("benign_accuracy") or float("nan")
+        title = (
+            f"{cell['rule']} x {cell['attack']} x {cell['topology']} — "
+            f"honest accuracy vs strength (benign {_fmt(benign, 3)})"
+        )
+        ct = Table(title=title)
+        ct.add_column("strength", justify="right")
+        ct.add_column("mean acc", justify="right")
+        ct.add_column("std", justify="right")
+        ct.add_column("vs benign")
+        ct.add_column("attacker state")
+        for row in cell.get("curve", []):
+            frac = (
+                row["mean"] / benign
+                if benign and math.isfinite(benign) and benign > 0
+                else float("nan")
+            )
+            adaptive = row.get("adaptive") or {}
+            summary = ""
+            if adaptive:
+                # Mean converged state over seeds: the attacker's own
+                # account of the margin it found (atk_lo / atk_z).
+                keys = sorted({k for d in adaptive.values() for k in d})
+                show = [
+                    k for k in ("atk_lo", "atk_z", "atk_accept_ema")
+                    if k in keys
+                ]
+                summary = "  ".join(
+                    f"{k}={_fmt(_mean([d.get(k, float('nan')) for d in adaptive.values()]), 2)}"
+                    for k in show
+                )
+            ct.add_row(
+                f"{row['strength']:.3g}", _fmt(row["mean"], 3),
+                _fmt(row.get("std", float("nan")), 3), _bar(frac), summary,
+            )
+        console.print(ct)

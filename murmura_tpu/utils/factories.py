@@ -22,13 +22,34 @@ from murmura_tpu.topology.dynamic import MobilityModel
 from murmura_tpu.topology.generators import create_topology
 
 
+def select_compromised_count(n: int, pct: float, seed: int) -> int:
+    """Size of the compromised set a (n, pct, seed) selection yields —
+    the fail-loud guards below need the count before building anything."""
+    from murmura_tpu.attacks.base import select_compromised
+
+    return int(select_compromised(n, pct, seed).sum())
+
+
 def build_attack(config: Config) -> Optional[Attack]:
-    """Instantiate the attack from config (reference: factories.py:123-174)."""
+    """Instantiate the attack from config (reference: factories.py:123-174).
+
+    With ``attack.adaptive.enabled`` (schema validated it against the
+    backend/type), the static attack becomes its closed-loop twin
+    (attacks/adaptive.py): ``alie`` maps to adaptive ALIE, every other
+    broadcast attack is wrapped in the generic scale bisection.
+    """
     if not config.attack.enabled or not config.attack.type:
         return None
     n = config.topology.num_nodes
     pct = config.attack.percentage
     p = config.attack.params
+    ad = config.attack.adaptive
+    if ad.enabled and config.backend == "distributed":
+        # Schema already rejects this; direct library construction gets
+        # the same loud refusal (the adaptation loop is in-jit only).
+        raise ConfigError(
+            "adaptive attacks are not wired into backend: distributed"
+        )
     # Compromised-set selection seed.  Defaults to the experiment seed (the
     # reference's behavior); an explicit attack.params.seed pins the
     # Byzantine placement independently of experiment.seed — the knob gang
@@ -38,22 +59,37 @@ def build_attack(config: Config) -> Optional[Attack]:
     # follow the member seed.
     seed = int(p.get("seed", config.experiment.seed))
 
+    def _bisect(inner: Attack) -> Attack:
+        """Apply the adaptive scale-bisection wrapper when configured."""
+        if not ad.enabled:
+            return inner
+        from murmura_tpu.attacks.adaptive import make_bisection_attack
+
+        return make_bisection_attack(
+            inner,
+            scale_init=ad.scale_init,
+            scale_max=ad.scale_max,
+            growth=ad.growth,
+            accept_target=ad.accept_target,
+            ema_beta=ad.ema_beta,
+        )
+
     if config.attack.type == "gaussian":
         # "std" is the reference's alternate key for the noise scale
         # (examples/configs/uci_har_byzantine.yaml).
-        return ATTACKS["gaussian"](
+        return _bisect(ATTACKS["gaussian"](
             num_nodes=n,
             attack_percentage=pct,
             noise_std=float(p.get("noise_std", p.get("std", 10.0))),
             seed=seed,
-        )
+        ))
     if config.attack.type == "directed_deviation":
-        return ATTACKS["directed_deviation"](
+        return _bisect(ATTACKS["directed_deviation"](
             num_nodes=n,
             attack_percentage=pct,
             lambda_param=float(p.get("lambda_param", -5.0)),
             seed=seed,
-        )
+        ))
     if config.attack.type in ("alie", "ipm"):
         # Colluding attacks: on simulation/tpu the jitted round step
         # computes the colluding vector from the TRUE honest rows
@@ -74,33 +110,59 @@ def build_attack(config: Config) -> Optional[Attack]:
                 "distributed backend"
             )
         if config.attack.type == "alie":
-            if config.backend == "distributed":
-                from murmura_tpu.attacks.base import select_compromised
+            estimator = str(p.get("estimator", "omniscient"))
+            if estimator not in ("omniscient", "coalition"):
+                raise ConfigError(
+                    f"attack.params.estimator must be 'omniscient' or "
+                    f"'coalition', got {estimator!r}"
+                )
+            if (
+                config.backend == "distributed" or estimator == "coalition"
+            ) and select_compromised_count(n, pct, seed) < 2:
+                # The coalition estimator (the paper's construction —
+                # the ZMQ backend always, the jitted backends under
+                # params.estimator: coalition) needs >= 2 colluders:
+                # with one, sigma over the coalition sample is 0 and
+                # mu - z*s degenerates to the colluder's benign state
+                # — a silent no-attack run labeled "under ALIE" (ipm
+                # has no such minimum: -eps*own is still an attack).
+                raise ConfigError(
+                    "the ALIE coalition estimator needs at least 2 "
+                    "compromised nodes (mu/sigma over the coalition "
+                    "sample is degenerate with 1); raise "
+                    "attack.percentage, or use the omniscient estimator "
+                    "on backend: simulation/tpu"
+                )
+            if ad.enabled:
+                from murmura_tpu.attacks.adaptive import (
+                    make_adaptive_alie_attack,
+                )
 
-                if select_compromised(n, pct, seed).sum() < 2:
-                    # The ZMQ coalition estimator needs >= 2 colluders:
-                    # with one, sigma over the coalition sample is 0 and
-                    # mu - z*s degenerates to the colluder's benign state
-                    # — a silent no-attack run labeled "under ALIE" (ipm
-                    # has no such minimum: -eps*own is still an attack).
-                    raise ConfigError(
-                        "attack type 'alie' on backend: distributed needs "
-                        "at least 2 compromised nodes (the coalition "
-                        "mu/sigma estimator is degenerate with 1); raise "
-                        "attack.percentage or use backend: simulation/tpu"
-                    )
+                return make_adaptive_alie_attack(
+                    num_nodes=n,
+                    attack_percentage=pct,
+                    z=p.get("z"),
+                    seed=seed,
+                    estimator=estimator,
+                    eta=ad.eta,
+                    accept_target=ad.accept_target,
+                    ema_beta=ad.ema_beta,
+                    z_min=ad.z_min,
+                    z_cap=ad.z_cap,
+                )
             return ATTACKS["alie"](
                 num_nodes=n,
                 attack_percentage=pct,
                 z=p.get("z"),
                 seed=seed,
+                estimator=estimator,
             )
-        return ATTACKS["ipm"](
+        return _bisect(ATTACKS["ipm"](
             num_nodes=n,
             attack_percentage=pct,
             epsilon=p.get("epsilon"),
             seed=seed,
-        )
+        ))
     if config.attack.type == "label_flip":
         if config.backend == "distributed":
             # The ZMQ NodeProcess builds its own data shard; the poison
@@ -400,7 +462,7 @@ def _node_axis_sharded(config: Config, mesh=None) -> bool:
 
 
 def build_gang_from_config(config: Config, seeds=None, mesh=None,
-                           checkpoint_dir=None):
+                           checkpoint_dir=None, retain_init=False):
     """Gang wiring (core/gang.py): one traced round program, S stacked
     member experiments — the ``murmura sweep`` / ``murmura run --seeds``
     path.
@@ -461,12 +523,18 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
     )
     from murmura_tpu.topology.sparse import SparseTopology
 
-    if isinstance(topology, SparseTopology):
+    sparse = isinstance(topology, SparseTopology)
+    if sparse and config.backend == "tpu":
+        # The [k, N] edge mask rides the gang's vmap unbatched exactly
+        # like the dense [N, N] matrix (lifted for ISSUE 11 — the
+        # frontier sweeps sparse exponential graphs), but the gang MESH
+        # still shards adjacency on node rows: the sparse mask needs the
+        # edge_mask_sharding layout, which the gang path has not wired.
         raise ConfigError(
             "sparse topologies (exponential/one_peer) are not gang-"
-            "batchable yet: the gang mesh shards the [N, N] adjacency on "
-            "its node rows, and the sparse [k, N] edge mask needs a "
-            "different layout — run sparse experiments unganged"
+            "batchable on backend: tpu yet (the gang mesh lacks the "
+            "[k, N] edge-mask sharding layout) — use backend: simulation "
+            "for sparse gangs, or run sparse tpu experiments unganged"
         )
     if config.population is not None and config.population.enabled:
         raise ConfigError(
@@ -520,7 +588,13 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
         if i == 0:
             model = resolve_model(config, data)
             agg_params = dict(config.aggregation.params)
-            if config.backend == "tpu" and config.tpu.exchange == "ppermute":
+            if sparse:
+                # Sparse topologies always run the [k, N] edge-mask
+                # engine (the build_network_from_config wiring, shared
+                # semantics — see the comment there).
+                agg_params["exchange_offsets"] = list(topology.offsets)
+                agg_params["sparse_exchange"] = True
+            elif config.backend == "tpu" and config.tpu.exchange == "ppermute":
                 if mobility is not None or config.dmtt is not None:
                     raise ConfigError(
                         "tpu.exchange: ppermute requires a static circulant "
@@ -537,6 +611,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             if (
                 config.aggregation.algorithm
                 in ("krum", "median", "trimmed_mean", "geometric_median")
+                and not sparse
                 and mobility is None
                 and config.dmtt is None
             ):
@@ -577,6 +652,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             faults=build_fault_spec(config),
             audit_taps=config.telemetry.audit_taps,
             hp_inputs=hp_inputs,
+            sparse_offsets=tuple(topology.offsets) if sparse else None,
             compression=build_compression_spec(config),
         ))
 
@@ -620,6 +696,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             recompile_guard=config.tpu.recompile_guard,
             transfer_guard=config.tpu.transfer_guard,
             telemetry_writers=writers,
+            retain_init=retain_init,
         )
     except ValueError as e:
         # Gang-batchability failures (ragged member shapes, unfactorable

@@ -15,6 +15,7 @@ GANG=0
 POPULATION=0
 COMPRESS=0
 RESUME=0
+FRONTIER=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -23,6 +24,7 @@ while :; do
     --population) POPULATION=1; shift;;
     --compress) COMPRESS=1; shift;;
     --resume) RESUME=1; shift;;
+    --frontier) FRONTIER=1; shift;;
     *) break;;
   esac
 done
@@ -181,6 +183,77 @@ PYEOF
     exit 1
   fi
   echo "preflight gang clean" | tee -a "$OUT/battery.log"
+fi
+# Optional frontier pre-flight (./run_tpu_battery.sh --frontier [outdir]):
+# a CPU-pinned 2-strength x 2-seed mini-frontier on krum
+# (docs/ROBUSTNESS.md "The robustness frontier") must (a) cost exactly
+# ONE compile for the whole bucket across both successive-halving stages
+# under tpu.recompile_guard — the reset_run re-aim is value-only over the
+# warm executables — and (b) produce a monotone (non-increasing)
+# accuracy-vs-strength curve; if either breaks, a full frontier sweep
+# would burn its budget recompiling or chart noise.
+if [ "${FRONTIER:-0}" = 1 ]; then
+  echo "=== preflight: frontier mini-sweep (1 compile/bucket + monotone curve) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 900 env JAX_PLATFORMS=cpu python - > "$OUT/preflight_frontier.out" 2>&1 <<'PYEOF'
+import sys
+
+from murmura_tpu.config import Config
+from murmura_tpu.frontier import run_frontier
+
+raw = {
+    "experiment": {"name": "frontier-preflight", "seed": 7, "rounds": 2,
+                   "verbose": False},
+    "topology": {"type": "ring", "num_nodes": 5},
+    "aggregation": {"algorithm": "krum", "params": {"num_compromised": 1}},
+    "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+    "data": {"adapter": "synthetic",
+             "params": {"num_samples": 40, "input_shape": [6],
+                        "num_classes": 3}},
+    "model": {"factory": "mlp",
+              "params": {"input_dim": 6, "hidden_dims": [8],
+                         "num_classes": 3}},
+    "backend": "simulation",
+    # recompile_guard arms CompileTracker inside the gang: any compile
+    # after the bucket's warmup raises instead of silently re-lowering.
+    "tpu": {"recompile_guard": True, "num_devices": 1,
+            "compute_dtype": "float32"},
+    "frontier": {"rules": ["krum"], "attacks": ["gaussian"],
+                 "topologies": ["dense"], "points": 2, "stages": 2,
+                 "seeds": [7, 11], "rounds": 2,
+                 "strength_lo": 0.5, "strength_hi": 4.0},
+}
+artifact = run_frontier(Config.model_validate(raw))
+(cell,) = artifact["cells"]
+print(f"compiles={cell['compiles']} stages={cell['stages']}")
+if cell["compiles"] != 1:
+    print(f"FAIL: bucket cost {cell['compiles']} compiles, expected "
+          "exactly 1 (the successive-halving stages must reuse the warm "
+          "gang executables)")
+    sys.exit(1)
+curve = cell["curve"]
+for row in curve:
+    print(f"  strength {row['strength']:.3g}: mean {row['mean']:.4f}")
+benign = curve[0]["mean"]
+for row in curve[1:]:
+    if row["mean"] > benign + 0.05:
+        print(f"FAIL: accuracy at strength {row['strength']:.3g} "
+              f"({row['mean']:.4f}) exceeds benign ({benign:.4f}) — the "
+              "curve is not monotone non-increasing")
+        sys.exit(1)
+means = [row["mean"] for row in curve]
+for a, b in zip(means, means[1:]):
+    if b > a + 0.05:
+        print("FAIL: accuracy-vs-strength curve is not monotone "
+              f"non-increasing: {means}")
+        sys.exit(1)
+print("frontier preflight ok")
+PYEOF
+  then
+    echo "preflight frontier FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_frontier.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight frontier clean" | tee -a "$OUT/battery.log"
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

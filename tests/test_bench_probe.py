@@ -115,3 +115,35 @@ def pytest_fail(msg):
     import pytest
 
     pytest.fail(msg)
+
+
+class TestPlatformOverwriteGuard:
+    """ISSUE 11 satellite: bench.py/bench_scaling.py refuse to merge a
+    new artifact over one with a different ``platform`` stamp unless
+    --force (the r03-r05 CPU-fallback artifacts silently shadowed TPU
+    history; the per-point stamps landed in ISSUE 10, the guard here)."""
+
+    def test_mismatch_refused_with_exit_2(self):
+        import pytest
+
+        with pytest.raises(SystemExit) as e:
+            bench.refuse_platform_shadowing(
+                "x.json", "tpu", "cpu", False, "bench"
+            )
+        assert e.value.code == 2
+
+    def test_same_platform_and_force_pass(self):
+        bench.refuse_platform_shadowing("x.json", "tpu", "tpu", False, "b")
+        bench.refuse_platform_shadowing("x.json", "tpu", "cpu", True, "b")
+
+    def test_absent_or_unstamped_artifact_passes(self):
+        # Pre-stamp artifacts carry no platform: overwritable (there is
+        # no provenance to protect).
+        bench.refuse_platform_shadowing("x.json", None, "cpu", False, "b")
+
+    def test_existing_platform_read_from_manifest(self, tmp_path):
+        assert bench.existing_bench_platform(tmp_path) is None
+        (tmp_path / "manifest.json").write_text(json.dumps(
+            {"kind": "bench", "summary": {"platform": "tpu"}}
+        ))
+        assert bench.existing_bench_platform(tmp_path) == "tpu"

@@ -167,3 +167,46 @@ def test_run_profile_flag_rejected_on_distributed(tmp_path):
     result = CliRunner().invoke(app, ["run", str(cfg), "--profile"])
     assert result.exit_code != 0
     assert "--profile" in result.output
+
+
+def test_frontier_cli_writes_artifact_and_report_renders(tmp_path):
+    # The `murmura frontier` -> `murmura report --frontier` round trip on
+    # a single tiny cell (docs/ROBUSTNESS.md "The robustness frontier").
+    cfg = _write_cfg(
+        tmp_path,
+        aggregation={"algorithm": "krum", "params": {"num_compromised": 1}},
+        attack={"enabled": True, "type": "gaussian", "percentage": 0.3,
+                "params": {"noise_std": 5.0}},
+        frontier={"rules": ["krum"], "attacks": ["gaussian"],
+                  "topologies": ["dense"], "points": 2, "stages": 1,
+                  "rounds": 2, "strength_lo": 0.5, "strength_hi": 4.0},
+    )
+    out = tmp_path / "frontier.json"
+    result = CliRunner().invoke(app, ["frontier", str(cfg), "-o", str(out)])
+    assert result.exit_code == 0, result.output
+    artifact = json.loads(out.read_text())
+    (cell,) = artifact["cells"]
+    assert cell["rule"] == "krum" and cell["compiles"] <= 2
+    rendered = CliRunner().invoke(app, ["report", "--frontier", str(out)])
+    assert rendered.exit_code == 0, rendered.output
+    assert "krum" in rendered.output
+    as_json = CliRunner().invoke(
+        app, ["report", "--frontier", str(out), "--json"]
+    )
+    assert as_json.exit_code == 0
+    assert json.loads(as_json.output)["summary"][0]["rule"] == "krum"
+
+
+def test_report_without_run_dir_or_frontier_errors():
+    result = CliRunner().invoke(app, ["report"])
+    assert result.exit_code == 1
+    assert "RUN_DIR" in result.output
+
+
+def test_frontier_cli_renders_unknown_rule_cleanly(tmp_path):
+    cfg = _write_cfg(
+        tmp_path, frontier={"rules": ["krum", "nope"]},
+    )
+    result = CliRunner().invoke(app, ["frontier", str(cfg)])
+    assert result.exit_code == 1
+    assert "Config error" in result.output and "nope" in result.output

@@ -401,6 +401,26 @@ class TestCrashMatrix:
         full, resumed = _crash_resume({}, 2, 4, fused=2)
         _assert_same_run(full, resumed, "fused@r2")
 
+    def test_adaptive_attack_state_survives(self):
+        # The closed-loop attacker's bracket/EMA (ATTACK_STATE_KEYS) is
+        # round-crossing state: killing mid-bisection and dropping it
+        # would resume a silently-cold adversary whose probe restarts
+        # from scale_init — the frontier's curves would then depend on
+        # where the battery got interrupted.
+        over = {"attack": {"enabled": True, "type": "gaussian",
+                           "percentage": 0.3,
+                           "params": {"noise_std": 5.0},
+                           "adaptive": {"enabled": True}}}
+        full, resumed = _crash_resume(over, 2, 4)
+        from murmura_tpu.attacks.adaptive import ATTACK_STATE_KEYS
+
+        carried = set(ATTACK_STATE_KEYS) & set(full.agg_state)
+        assert carried, (
+            "the cell must actually carry adaptation state for this test "
+            "to mean anything"
+        )
+        _assert_same_run(full, resumed, "adaptive@r2")
+
     def test_int8_ef_carried_residual_survives(self):
         # The EF residual is round-crossing state: killing between rounds
         # and dropping it would silently decay compression accuracy.
@@ -426,6 +446,10 @@ class TestCrashMatrix:
             "compressed": {"compression": {"algorithm": "int8",
                                            "error_feedback": True,
                                            "block": 64}},
+            "adaptive": {"attack": {"enabled": True, "type": "gaussian",
+                                    "percentage": 0.3,
+                                    "params": {"noise_std": 5.0},
+                                    "adaptive": {"enabled": True}}},
         }
         assert set(mode_over) == set(DURABILITY_MODES)
         for mode, over in mode_over.items():

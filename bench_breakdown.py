@@ -16,6 +16,10 @@ timing nested subsets of the round program on the bench configuration
                  pairwise distance matmuls + candidate-block selection.
     eval       — the separately compiled eval sweep (paid only on
                  eval_every rounds since round 3's eval split).
+    staleness  — bounded-staleness cells (ISSUE 13): the same krum round
+                 under a 30% straggler + link-drop FaultSchedule, drop-
+                 sync baseline vs max_staleness {1, 4}, with per-round
+                 stale-edge counts committed in the manifest.
 
 Writes bench_breakdown.json (committed) and prints it.  Run on the real
 TPU (default env); the numbers anchor the MFU narrative in BENCH_r03.
@@ -182,6 +186,82 @@ def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
     return program, attack
 
 
+def _staleness_cells(nodes: int) -> dict:
+    """Bounded-staleness cells (ISSUE 13; docs/ROBUSTNESS.md): the same
+    krum scenario under a 30% straggler + 15% link-drop FaultSchedule,
+    run drop-sync vs ``max_staleness`` in {1, 4}.  Each cell reports the
+    amortized fused-dispatch ms/round (the chain-timing trick applied
+    through ``rounds_per_dispatch`` — one dispatch per chunk, fixed
+    tunnel latency amortized), the final mean accuracy, and the
+    PER-ROUND stale-edge counts so the manifest shows how much of the
+    exchange actually ran from cache."""
+    from murmura_tpu.config import Config
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    rounds = 4 if SMOKE else 10
+    cells = {}
+    for name, exchange in (
+        ("drop_sync", None),
+        ("stale_1", {"max_staleness": 1}),
+        ("stale_4", {"max_staleness": 4}),
+    ):
+        import copy
+
+        raw = copy.deepcopy(flagship_cfg(nodes))
+        if SMOKE:
+            raw["data"]["params"]["num_samples"] = (
+                16 * raw["topology"]["num_nodes"]
+            )
+            if "leaf" in raw["model"]["factory"].lower():
+                raw["model"]["params"] = {"variant": "tiny"}
+        raw["experiment"]["rounds"] = rounds
+        raw["faults"] = {"enabled": True, "straggler_prob": 0.3,
+                         "link_drop_prob": 0.15, "seed": 11}
+        if exchange is not None:
+            raw["exchange"] = exchange
+        net = build_network_from_config(Config.model_validate(raw))
+        # eval_every=1 keeps every round in history (the per-round
+        # stale-edge counts ARE the deliverable); the in-scan eval cost
+        # is identical across the three cells, so the ms deltas stay
+        # attributable to the stale fold.  Warmup runs the SAME
+        # (chunk, eval_every) fused program as the timed pass —
+        # Network._fused_step caches compiled programs per chunk size,
+        # so a different warmup chunk would leave the timed window
+        # paying the full XLA compile.
+        net.train(rounds=rounds, eval_every=1, rounds_per_dispatch=rounds)
+        t0 = time.perf_counter()
+        h = net.train(
+            rounds=rounds, eval_every=1, rounds_per_dispatch=rounds
+        )
+        elapsed = time.perf_counter() - t0
+        sched = net.fault_schedule
+        # Host-side schedule view next to the in-jit observation: how
+        # many senders the schedule itself kept from delivering each
+        # timed round (in-jit sentinels can only veto further).
+        nondeliv = [
+            int((sched.delivering_at(r) < 1).sum())
+            for r in range(rounds, 2 * rounds)
+        ]
+        cells[name] = {
+            "ms_per_round": round(1e3 * elapsed / rounds, 3),
+            "final_mean_accuracy": round(float(h["mean_accuracy"][-1]), 4),
+            "scheduled_nondelivering_per_round": nondeliv,
+            "stale_edges_per_round": [
+                float(v) for v in h.get("agg_stale_used", [])[-rounds:]
+            ],
+            "stale_expired_per_round": [
+                float(v) for v in h.get("agg_stale_expired", [])[-rounds:]
+            ],
+        }
+    return {
+        "config": "krum, 30% straggler + 15% link drop, "
+                  f"{nodes}-node k-regular(4), fused dispatch with "
+                  "per-round in-scan eval",
+        "rounds": rounds,
+        "cells": cells,
+    }
+
+
 def main():
     import os
     import sys
@@ -311,6 +391,11 @@ def main():
         },
     }
 
+    # Bounded-staleness cells (ISSUE 13): drop-sync baseline vs
+    # max_staleness {1, 4} under a 30% straggler schedule, per-round
+    # stale-edge counts committed in the manifest.
+    stale_section = _staleness_cells(nodes)
+
     if nodes != 20:
         # Scale runs measure only the flagship segments; the probe
         # scenario is scale-independent (its own 10-node config).
@@ -320,6 +405,7 @@ def main():
             **_platform_stamp(),
             "num_nodes": nodes,
             "segments": seg,
+            "staleness": stale_section,
             "raw": results,
         }
         if SMOKE:
@@ -373,6 +459,7 @@ def main():
         "backend": jax.default_backend(),
         **_platform_stamp(),
         "segments": seg,
+        "staleness": stale_section,
         "probe_scenario": {
             "config": "evidential_trust, 10-node fully, UCI-HAR-shaped, "
                        "max_eval_samples=64",

@@ -42,6 +42,7 @@ def run_check_detailed(
     flow: Optional[bool] = None,
     durability: Optional[bool] = None,
     adaptive: Optional[bool] = None,
+    staleness: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -56,11 +57,16 @@ def run_check_detailed(
     rule x exchange mode), and when ``adaptive`` is enabled the
     adaptive-adversary contracts (analysis/adaptive.py, MUR1000-1003:
     attack-state registry bijection, recompile-free adaptation,
-    collective-inventory parity, feedback taint containment).
-    ``ir=None``/``flow=None``/``durability=None``/``adaptive=None`` mean
-    "on for the package check, off for explicit paths" (all four passes
-    are package-global: they exercise the live registry, not the files
-    named on the command line).
+    collective-inventory parity, feedback taint containment), and when
+    ``staleness`` is enabled the bounded-staleness contracts
+    (analysis/staleness.py, MUR1100-1103: stale-state registry
+    bijection, zero recompiles across staleness variation,
+    collective-inventory parity with the drop-sync program, and the
+    influence-bound/replay-hole taint runs over the staleness path).
+    ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
+    ``staleness=None`` mean "on for the package check, off for explicit
+    paths" (all five passes are package-global: they exercise the live
+    registry, not the files named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -72,6 +78,7 @@ def run_check_detailed(
     run_flow = flow if flow is not None else not paths
     run_durability = durability if durability is not None else not paths
     run_adaptive = adaptive if adaptive is not None else not paths
+    run_staleness = staleness if staleness is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -99,6 +106,10 @@ def run_check_detailed(
         from murmura_tpu.analysis import adaptive as adaptive_mod
 
         findings.extend(adaptive_mod.check_adaptive())
+    if run_staleness:
+        from murmura_tpu.analysis import staleness as staleness_mod
+
+        findings.extend(staleness_mod.check_staleness())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -110,12 +121,13 @@ def run_check(
     flow: Optional[bool] = None,
     durability: Optional[bool] = None,
     adaptive: Optional[bool] = None,
+    staleness: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
-        adaptive=adaptive,
+        adaptive=adaptive, staleness=staleness,
     )[0]
 
 

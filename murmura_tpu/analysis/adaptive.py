@@ -47,10 +47,12 @@ import numpy as np
 
 from murmura_tpu.analysis.lint import Finding
 
-# The two adaptive-attack kinds the grids sweep: adaptive ALIE (the
-# variance-quantile z walk) and the generic scale bisection wrapped around
-# the gaussian attack — the pair `murmura frontier` charts.
-ADAPTIVE_ATTACK_KINDS: Tuple[str, ...] = ("alie", "gaussian")
+# The adaptive-attack kinds the grids sweep: adaptive ALIE (the
+# variance-quantile z walk), the generic scale bisection wrapped around
+# the gaussian attack (the pair `murmura frontier` charts), and adaptive
+# IPM (the epsilon walk on the paper's own mean-negation axis — the
+# ISSUE-13 follow-up).
+ADAPTIVE_ATTACK_KINDS: Tuple[str, ...] = ("alie", "gaussian", "ipm")
 
 # Registry of check families in this module: name -> callable, scanned by
 # analysis/ir.py's check_coverage so an unwired family is a MUR205
@@ -80,12 +82,15 @@ def _build_adaptive(kind: str, n: int, pct: float = 0.3, seed: int = 7):
     """One adaptive attack of ``kind`` at size ``n`` (the grid cells')."""
     from murmura_tpu.attacks.adaptive import (
         make_adaptive_alie_attack,
+        make_adaptive_ipm_attack,
         make_bisection_attack,
     )
     from murmura_tpu.attacks.gaussian import make_gaussian_attack
 
     if kind == "alie":
         return make_adaptive_alie_attack(n, attack_percentage=pct, seed=seed)
+    if kind == "ipm":
+        return make_adaptive_ipm_attack(n, attack_percentage=pct, seed=seed)
     if kind == "gaussian":
         return make_bisection_attack(
             make_gaussian_attack(
@@ -436,6 +441,10 @@ def collective_cell_findings(rule: str, kind: str) -> List[Finding]:
     )
     if kind == "alie":
         static = make_alie_attack(n, attack_percentage=0.3, seed=7)
+    elif kind == "ipm":
+        from murmura_tpu.attacks.ipm import make_ipm_attack
+
+        static = make_ipm_attack(n, attack_percentage=0.3, seed=7)
     else:
         static = make_gaussian_attack(
             n, attack_percentage=0.3, noise_std=5.0, seed=7
@@ -665,11 +674,14 @@ def check_adaptive_influence() -> List[Finding]:
     from murmura_tpu.attacks.adaptive import ADAPTIVE_ATTACKS
 
     findings: List[Finding] = []
+    kind_of = {
+        "adaptive_alie": "alie",
+        "adaptive_ipm": "ipm",
+        "bisection": "gaussian",
+    }
     for name in sorted(ADAPTIVE_ATTACKS):
         try:
-            atk = _build_adaptive(
-                "alie" if name == "adaptive_alie" else "gaussian", 8
-            )
+            atk = _build_adaptive(kind_of.get(name, "gaussian"), 8)
             findings.extend(containment_findings(name, atk))
         except Exception as e:  # noqa: BLE001 — a crash IS the finding
             findings.append(Finding(

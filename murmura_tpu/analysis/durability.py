@@ -26,7 +26,7 @@ a finding here is a real regression: a new piece of carried state that
 missed the snapshot, or a restore path that perturbs placement.
 
 The grid is ``AGGREGATORS x (dense, circulant, sparse, compressed,
-adaptive)`` — the same rule inventory the IR/flow/budget sweeps use
+adaptive, stale)`` — the same rule inventory the IR/flow/budget sweeps use
 (``AGG_CASES`` keeps the bijection under MUR205).  Cells are tiny (5-8 nodes, an
 83-param MLP, 4 rounds) but compile-dominated (~3-4 s each), so the full
 sweep is memoized per process and runs by default only for the package
@@ -53,9 +53,13 @@ from murmura_tpu.analysis.lint import Finding
 # checkpoint would silently corrupt).  ``adaptive`` (ISSUE 11) runs the
 # dense exchange under a closed-loop bisection attack: the mode with
 # round-crossing ATTACK_STATE_KEYS state — a snapshot that dropped the
-# attacker's bracket would resume a silently-cold adversary.
+# attacker's bracket would resume a silently-cold adversary.  ``stale``
+# (ISSUE 13) runs the dense exchange under a straggler/link-drop fault
+# schedule with bounded staleness armed: the mode with round-crossing
+# STALE_STATE_KEYS state — a snapshot that dropped the payload cache
+# would resume serving zeros as "cached" neighbor models.
 DURABILITY_MODES: Tuple[str, ...] = (
-    "dense", "circulant", "sparse", "compressed", "adaptive"
+    "dense", "circulant", "sparse", "compressed", "adaptive", "stale"
 )
 
 # Registry of check families in this module: name -> callable, scanned by
@@ -126,6 +130,10 @@ def _cell_config(rule: str, mode: str):
         raw["attack"] = {"enabled": True, "type": "gaussian",
                          "percentage": 0.3, "params": {"noise_std": 5.0},
                          "adaptive": {"enabled": True}}
+    elif mode == "stale":
+        raw["faults"] = {"enabled": True, "straggler_prob": 0.4,
+                         "link_drop_prob": 0.2, "seed": 11}
+        raw["exchange"] = {"max_staleness": 2, "staleness_discount": 0.5}
     elif mode != "dense":
         raise ValueError(f"unknown durability mode {mode!r}")
     return Config.model_validate(raw)

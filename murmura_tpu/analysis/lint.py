@@ -118,6 +118,12 @@ RULES: Dict[str, str] = {
     "MUR1001": "adaptive-attack-recompile",
     "MUR1002": "adaptive-collective-inventory",
     "MUR1003": "adaptive-influence-containment",
+    # 11xx = bounded-staleness contracts (analysis/staleness.py;
+    # docs/ROBUSTNESS.md "Bounded staleness")
+    "MUR1100": "stale-state-registry",
+    "MUR1101": "stale-recompile",
+    "MUR1102": "stale-collective-inventory",
+    "MUR1103": "stale-influence-replay-hole",
 }
 
 

@@ -11,7 +11,7 @@ reads the audit-tap acceptance signal the aggregation rule itself emitted
 (``tap_selected_by``/``tap_considered_by`` — telemetry leg of PR 4) for its
 compromised rows and tunes its strength for the next round.
 
-Two adaptive attacks ship:
+Three adaptive attacks ship:
 
 - **adaptive ALIE** (:func:`make_adaptive_alie_attack`): the colluding
   vector's deviation factor ``z`` is per-node carried state updated by a
@@ -19,6 +19,11 @@ Two adaptive attacks ship:
   (the colluders creep toward the krum/BALANCE margin), rejected rounds
   pull it back inside the benign variance envelope.  The equilibrium z
   IS the empirical selection margin of the defense.
+- **adaptive IPM** (:func:`make_adaptive_ipm_attack`): the inner-product
+  manipulation's negation factor ``epsilon`` is per-node carried state
+  driven by the same acceptance walk — the equilibrium epsilon is the
+  largest mean-negation the defense admits, directly on the paper's
+  own strength axis (``-epsilon * mu_honest``).
 - **scale bisection** (:func:`make_bisection_attack`): a generic wrapper
   that turns ANY static broadcast attack into "largest strength still
   accepted" — per-node bracket state (``atk_lo`` = largest accepted,
@@ -72,6 +77,7 @@ from murmura_tpu.attacks.alie import resolve_alie_z
 # treat them exactly like any other node-indexed carried state.
 ATTACK_STATE_KEYS = (
     "atk_accept_ema",  # EMA of the row's acceptance fraction
+    "atk_eps",         # adaptive IPM: current negation factor epsilon
     "atk_hi",          # bisection: smallest strength observed rejected
     "atk_lo",          # bisection: largest strength observed accepted
     "atk_scale",       # bisection: strength probed next round
@@ -281,6 +287,102 @@ def make_adaptive_alie_attack(
     )
 
 
+def make_adaptive_ipm_attack(
+    num_nodes: int,
+    attack_percentage: float,
+    epsilon: Optional[float] = None,
+    seed: int = 42,
+    eta: float = 0.25,
+    accept_target: float = 0.0,
+    ema_beta: float = 0.5,
+    eps_min: float = 0.05,
+    eps_cap: Optional[float] = None,
+) -> AdaptiveAttack:
+    """IPM (attacks/ipm.py: ``malicious = -epsilon * mu_honest``) whose
+    negation factor epsilon is per-node carried state under ``atk_eps``,
+    updated by the same multiplicative acceptance walk as adaptive
+    ALIE's z: accepted rounds multiply epsilon by ``1 + eta`` (push the
+    inner product further negative — toward the outright update flip at
+    epsilon >= 1), rejected rounds by ``1 - eta`` (duck back into the
+    stealth regime distance filters admit), clamped to
+    ``[eps_min, eps_cap]``.  The starting epsilon is the paper's default
+    (or the explicit override) — exactly the static attack's strength.
+
+    Where the generic bisection wrapper scales the *perturbation* of a
+    benignly-trained state, this walks the attack's OWN semantic knob:
+    the equilibrium epsilon is the largest mean-negation the defense
+    still accepts, directly comparable to the paper's epsilon axis
+    (PR 11 follow-up; ROADMAP item 4's remaining list).
+    """
+    from murmura_tpu.attacks.ipm import make_ipm_attack, resolve_ipm_epsilon
+
+    static = make_ipm_attack(
+        num_nodes, attack_percentage, epsilon=epsilon, seed=seed
+    )
+    comp_idx = np.flatnonzero(static.compromised)
+    eps0 = resolve_ipm_epsilon(epsilon)
+    cap = float(eps_cap) if eps_cap is not None else max(4.0 * abs(eps0), 4.0)
+    state_keys = ("atk_accept_ema", "atk_eps")
+
+    def init_attack_state(n: int) -> Dict[str, np.ndarray]:
+        return {
+            "atk_eps": np.full(n, eps0, np.float32),
+            "atk_accept_ema": np.ones(n, np.float32),
+        }
+
+    def apply_adaptive(flat, compromised_mask, key, round_idx, state):
+        if flat.shape[0] != num_nodes or not len(comp_idx):
+            return flat  # per-node view: no population statistics here
+        from murmura_tpu.attacks.base import honest_mean
+
+        mu = honest_mean(flat, compromised_mask)  # [1, P] f32
+        eps_rows = state["atk_eps"].astype(jnp.float32)[:, None]  # [N, 1]
+        malicious = (-eps_rows * mu).astype(flat.dtype)
+        return jnp.where(compromised_mask[:, None] > 0, malicious, flat)
+
+    def update_attack_state(state, accept, observed, compromised_mask):
+        upd = compromised_mask * observed
+        ema = _gated(
+            upd,
+            (1.0 - ema_beta) * state["atk_accept_ema"] + ema_beta * accept,
+            state["atk_accept_ema"],
+        )
+        # Round acceptance, not the EMA, drives the step direction (the
+        # adaptive-ALIE rationale above: an EMA threshold never flips
+        # back after a rejection streak).
+        accepted = (accept > accept_target).astype(jnp.float32)
+        eps_new = state["atk_eps"] * jnp.where(
+            accepted > 0, 1.0 + eta, 1.0 - eta
+        )
+        eps_new = jnp.clip(eps_new, eps_min, cap)
+        return {
+            "atk_accept_ema": ema,
+            "atk_eps": _gated(upd, eps_new, state["atk_eps"]),
+        }
+
+    def strength_stats(state, compromised_mask):
+        return {
+            "atk_eps": state["atk_eps"] * compromised_mask,
+            "atk_accept_ema": state["atk_accept_ema"] * compromised_mask,
+        }
+
+    return AdaptiveAttack(
+        name="adaptive_ipm",
+        compromised=static.compromised,
+        apply=static.apply,
+        # The coalition trains benignly so the omniscient honest mean the
+        # colluders negate tracks real gradients, and eps -> eps_min
+        # degrades toward (scaled) honest behavior — the bisection
+        # wrapper's rationale for the wrapped attacks.
+        trains_locally=True,
+        state_keys=state_keys,
+        init_attack_state=init_attack_state,
+        apply_adaptive=apply_adaptive,
+        update_attack_state=update_attack_state,
+        strength_stats=strength_stats,
+    )
+
+
 def make_bisection_attack(
     inner: Attack,
     scale_init: float = 1.0,
@@ -412,6 +514,9 @@ def _probe_bisection() -> AdaptiveAttack:
 
 ADAPTIVE_ATTACKS: Dict[str, Callable[[], AdaptiveAttack]] = {
     "adaptive_alie": lambda: make_adaptive_alie_attack(
+        4, attack_percentage=0.25
+    ),
+    "adaptive_ipm": lambda: make_adaptive_ipm_attack(
         4, attack_percentage=0.25
     ),
     "bisection": _probe_bisection,

@@ -640,6 +640,16 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "for the package check, off when explicit PATHS are given.",
 )
 @click.option(
+    "--staleness/--no-staleness", "staleness", default=None,
+    help="Run the bounded-staleness contracts (MUR1100-1103: stale-state "
+         "registry bijection, zero recompiles across staleness "
+         "variation, collective-inventory parity with the drop-sync "
+         "program, influence-bound/replay-hole taint runs over the "
+         "staleness path).  Compiles and runs tiny programs (~1 min on "
+         "CPU).  Default: on for the package check, off when explicit "
+         "PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary records) as JSON "
          "lines for editor/CI annotation instead of the greppable text "
@@ -650,8 +660,8 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
     help="Re-measure the AOT cost grid and rewrite analysis/BUDGETS.json; "
          "review the diff as perf history.",
 )
-def check(paths, contracts, ir, flow, durability, adaptive, as_json,
-          update_budgets):
+def check(paths, contracts, ir, flow, durability, adaptive, staleness,
+          as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -662,8 +672,9 @@ def check(paths, contracts, ir, flow, durability, adaptive, as_json,
     the jaxpr dataflow contracts (MUR800-804: per-neighbor Byzantine
     influence bounds, NaN/attack scrub dominance, zero-free denominators),
     the durability contracts (MUR900 snapshot completeness via
-    --contracts; MUR901/902 resume determinism via --durability), and the
-    adaptive-adversary contracts (MUR1000-1003 via --adaptive).
+    --contracts; MUR901/902 resume determinism via --durability), the
+    adaptive-adversary contracts (MUR1000-1003 via --adaptive), and the
+    bounded-staleness contracts (MUR1100-1103 via --staleness).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -685,7 +696,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, as_json,
 
     findings, records = run_check_detailed(
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
-        durability=durability, adaptive=adaptive,
+        durability=durability, adaptive=adaptive, staleness=staleness,
     )
     if as_json:
         out = format_findings_json(findings, records)

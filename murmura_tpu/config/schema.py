@@ -64,9 +64,11 @@ class AdaptiveAttackConfig(_Strict):
     With ``enabled``, the configured attack tunes its own strength each
     round against the audit-tap acceptance signal inside the compiled
     round program: ``type: alie`` becomes adaptive ALIE (the deviation
-    factor z walks the defense's selection margin); every other
-    broadcast attack (gaussian/directed_deviation/ipm) is wrapped in the
-    generic scale bisection ("largest strength still accepted").  The
+    factor z walks the defense's selection margin); ``type: ipm``
+    becomes adaptive IPM (the negation factor epsilon walks the same
+    signal as carried state — the paper's own strength axis); every
+    other broadcast attack (gaussian/directed_deviation) is wrapped in
+    the generic scale bisection ("largest strength still accepted").  The
     adaptation state rides ``agg_state`` under the reserved
     ATTACK_STATE_KEYS, so durability snapshots resume a mid-bisection
     attacker byte-identically (MUR901's adaptive cell).  Default off =>
@@ -233,7 +235,11 @@ class FaultsConfig(_Strict):
         description=(
             "Per-round P(node straggles): its update misses the delivery "
             "deadline (jitted backends: outgoing contributions masked; "
-            "distributed: the node actually sleeps)"
+            "distributed: the node actually sleeps).  With "
+            "exchange.max_staleness >= 1 a straggle becomes a bounded "
+            "DELAY instead of a drop: receivers aggregate the "
+            "straggler's last delivered payload until the age bound "
+            "expires (docs/ROBUSTNESS.md 'Bounded staleness')"
         ),
     )
     straggler_factor: float = Field(
@@ -263,6 +269,47 @@ class FaultsConfig(_Strict):
     nan_inject_from_round: int = Field(
         default=0, ge=0,
         description="First round nan_inject_nodes emit NaNs",
+    )
+
+
+class ExchangeConfig(_Strict):
+    """Bounded-staleness gossip exchange (murmura_tpu extension; ISSUE 13
+    — docs/ROBUSTNESS.md "Bounded staleness"; PAPERS.md: asynchronous
+    quantized decentralized SGD arXiv:1910.12308, delayed averaging
+    arXiv:2002.01119).
+
+    With ``max_staleness`` >= 1 the round program carries a per-sender
+    payload cache + integer age stamp in ``agg_state`` (reserved
+    ``STALE_STATE_KEYS``, core/stale.py): when the fault model disrupts a
+    sender — a straggler, a crashed node, a link-isolated one — its
+    base-graph edges are re-added with the last *delivered* payload
+    instead of being dropped, as long as that payload's age stays within
+    the bound.  Quarantined/attack-scrubbed rows are withheld from the
+    cache path exactly like the fresh path (the MUR1103 replay-hole
+    contract), and ages past the bound degrade to today's drop-the-edge
+    behavior.
+
+    Default (``max_staleness: 0``) => byte-identical behavior to a config
+    without this block: the compiled round program, histories, and random
+    streams are untouched.
+    """
+
+    max_staleness: int = Field(
+        default=0, ge=0,
+        description=(
+            "Maximum rounds a cached neighbor payload may be served after "
+            "its sender last delivered (0 = off: disrupted edges drop, "
+            "today's strict-synchronous behavior)"
+        ),
+    )
+    staleness_discount: float = Field(
+        default=1.0, gt=0.0, le=1.0,
+        description=(
+            "Per-round-of-age multiplier on a re-added stale edge's "
+            "adjacency weight (weight = discount ** age).  Mean-family "
+            "rules honor the fraction; selection rules (krum/median/"
+            "trimmed) treat any positive weight as a full candidate"
+        ),
     )
 
 
@@ -674,6 +721,18 @@ class FrontierConfig(_Strict):
         default=None,
         description="Member seeds per strength (default: [experiment.seed])",
     )
+    percentages: Optional[List[float]] = Field(
+        default=None,
+        description=(
+            "Sweep axis over attack.percentage — the BREAKDOWN-POINT "
+            "axis: each value runs the full strength x seed successive-"
+            "halving search with that fraction of nodes compromised, as "
+            "its own compile-compatible gang bucket (the compromised set "
+            "is a trace-time attack closure, so percentages cannot share "
+            "a bucket the way strengths do).  None (default) = the base "
+            "config's attack.percentage only"
+        ),
+    )
     stages: int = Field(
         default=2, ge=1,
         description="Successive-halving refinement stages per cell",
@@ -710,6 +769,16 @@ class FrontierConfig(_Strict):
                 raise ValueError("frontier.seeds must be non-empty")
             if len(self.seeds) != len(set(self.seeds)):
                 raise ValueError("frontier.seeds must be distinct")
+        if self.percentages is not None:
+            if not self.percentages:
+                raise ValueError("frontier.percentages must be non-empty")
+            if len(self.percentages) != len(set(self.percentages)):
+                raise ValueError("frontier.percentages must be distinct")
+            bad = [p for p in self.percentages if not 0.0 < p < 1.0]
+            if bad:
+                raise ValueError(
+                    f"frontier.percentages must be in (0, 1), got {bad}"
+                )
         return self
 
 
@@ -936,6 +1005,15 @@ class Config(_Strict):
             "default (none) => byte-identical to no compression block"
         ),
     )
+    exchange: ExchangeConfig = Field(
+        default_factory=ExchangeConfig,
+        description=(
+            "Bounded-staleness gossip exchange (stale-tolerant cache + "
+            "age-bounded re-delivery under faults; docs/ROBUSTNESS.md); "
+            "default (max_staleness 0) => byte-identical to no exchange "
+            "block"
+        ),
+    )
     sweep: Optional[SweepConfig] = Field(
         default=None,
         description=(
@@ -989,8 +1067,8 @@ class Config(_Strict):
                 f"'{a.type}': label_flip poisons data (no broadcast "
                 "perturbation to scale) and topology_liar's claims "
                 "channel is not modeled by the adaptation state; use "
-                "gaussian/directed_deviation/ipm (bisection) or alie "
-                "(adaptive ALIE)"
+                "gaussian/directed_deviation (bisection), alie "
+                "(adaptive ALIE) or ipm (adaptive IPM)"
             )
         if self.backend == "distributed":
             raise ValueError(
@@ -1179,6 +1257,65 @@ class Config(_Strict):
                     "(cohort swaps reassign node slots); use stateless "
                     "int8 or disable the population block"
                 )
+        return self
+
+    @model_validator(mode="after")
+    def _exchange_is_wirable(self):
+        e = self.exchange
+        if e.max_staleness == 0:
+            if e.staleness_discount != 1.0:
+                # Same fail-loud discipline as the telemetry sub-settings:
+                # a discount without the staleness bound would silently
+                # run strict-synchronous while the config *looks* stale-
+                # tolerant.
+                raise ValueError(
+                    "exchange.staleness_discount requires "
+                    "exchange.max_staleness >= 1 (there is no stale edge "
+                    "to discount)"
+                )
+            return self
+        if not self.faults.enabled:
+            raise ValueError(
+                "exchange.max_staleness requires faults.enabled: true — "
+                "without the fault model nothing ever misses a round, so "
+                "the stale cache would be dead state in every program"
+            )
+        if self.backend == "distributed":
+            raise ValueError(
+                "bounded staleness runs inside the jitted round program "
+                "(the cache rides the scan carry); backend: distributed "
+                "realizes deadlines physically over ZMQ — use backend: "
+                "simulation or tpu"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "bounded staleness does not compose with dmtt (the "
+                "exchange graph is trust-gated per round; a cached row "
+                "would bypass the round's claim verification)"
+            )
+        if self.mobility is not None:
+            raise ValueError(
+                "bounded staleness does not compose with mobility: an "
+                "edge leaving G^t is topology change, not a fault, and "
+                "the re-add layer needs a static base graph baked at "
+                "trace time"
+            )
+        if self.topology.type == "one_peer":
+            raise ValueError(
+                "bounded staleness does not compose with the one_peer "
+                "topology (its active offset varies per round as mask "
+                "values, so there is no static base edge mask to re-add "
+                "from); use the exponential sparse family or a dense "
+                "topology"
+            )
+        if self.population is not None and self.population.enabled:
+            raise ValueError(
+                "bounded staleness does not compose with population "
+                "(the payload cache is per-slot [N, P] carried state; "
+                "cohort swaps reassign node slots, so a cached row would "
+                "be served into the wrong user's stream — the "
+                "compression carried-state rationale)"
+            )
         return self
 
     @model_validator(mode="after")

@@ -45,6 +45,12 @@ from murmura_tpu.dmtt.protocol import (
     init_dmtt_state,
 )
 from murmura_tpu.models.core import Model
+from murmura_tpu.core.stale import (
+    STALE_STATE_KEYS,
+    StalenessSpec,
+    init_stale_state,
+    make_stale_fold,
+)
 from murmura_tpu.ops.compress import (
     COMPRESS_STATE_KEYS,
     CompressionSpec,
@@ -116,10 +122,21 @@ class RoundProgram:
     # taps update it in-jit.  False (default) => the traced program is
     # byte-identical to pre-adaptive builds.
     adaptive_attack: bool = False
+    # Bounded-staleness gossip (core/stale.py; docs/ROBUSTNESS.md
+    # "Bounded staleness"): a per-sender payload cache + age stamp ride
+    # ``agg_state`` under STALE_STATE_KEYS, and disrupted base-graph
+    # edges are re-added with the (discounted) cached payload while its
+    # age stays within ``max_staleness``.  None (default) => the traced
+    # program is byte-identical to pre-staleness builds.
+    staleness: Optional[StalenessSpec] = None
 
     @property
     def sparse(self) -> bool:
         return bool(self.sparse_offsets)
+
+    @property
+    def stale(self) -> bool:
+        return self.staleness is not None
 
 
 def _broadcast_to_leaf(mask: jnp.ndarray, leaf: jnp.ndarray) -> jnp.ndarray:
@@ -149,6 +166,7 @@ def build_round_program(
     hp_inputs: Tuple[str, ...] = (),
     sparse_offsets: Optional[Tuple[int, ...]] = None,
     compression: Optional[CompressionSpec] = None,
+    staleness: Optional[StalenessSpec] = None,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -214,6 +232,38 @@ def build_round_program(
             "than the rules aggregate)"
         )
 
+    # Bounded-staleness gossip (core/stale.py): the exchange layer that
+    # serves a disrupted sender's last delivered payload (age-bounded,
+    # optionally discount-weighted) instead of dropping its edges.
+    if staleness is not None:
+        if faults is None:
+            raise ValueError(
+                "bounded staleness (exchange.max_staleness) requires the "
+                "fault model (build_round_program(faults=...)): without "
+                "a fault schedule nothing ever misses a round and the "
+                "cache layer would be dead weight in every program"
+            )
+        if dmtt is not None:
+            raise ValueError(
+                "bounded staleness does not compose with DMTT (the "
+                "exchange graph is trust-gated per round; serving a "
+                "cached row would bypass the round's claim verification)"
+            )
+        if staleness.base_mask is None:
+            raise ValueError(
+                "StalenessSpec.base_mask must carry the static base "
+                "exchange graph (the topology mask / all-active sparse "
+                "edge mask) — re-added edges are drawn from it"
+            )
+        expect = (
+            (len(sparse_offsets or ()), n) if sparse_offsets else (n, n)
+        )
+        if tuple(np.shape(staleness.base_mask)) != expect:
+            raise ValueError(
+                f"staleness base mask shape "
+                f"{tuple(np.shape(staleness.base_mask))} does not match "
+                f"this build's exchange layout {expect}"
+            )
     # Closed-loop adaptive attack (attacks/adaptive.py): the attacker's
     # adaptation state rides agg_state (ATTACK_STATE_KEYS) and the audit
     # taps ARE its feedback channel, so tapping is forced on — taps are
@@ -230,6 +280,16 @@ def build_round_program(
                 "does not model)"
             )
         audit_taps = True
+
+    # Built after the adaptive block so the fold's audit taps follow the
+    # final audit_taps value (adaptive attacks force tapping on).
+    if staleness is not None:
+        stale_fold = make_stale_fold(
+            staleness, sparse_offsets=tuple(sparse_offsets or ()),
+            audit=audit_taps,
+        )
+    else:
+        stale_fold = None
 
     def _sender_view(vec):  # murmura: traced
         """[k, N] sender-side view of a [N] node flag: row j holds
@@ -520,6 +580,7 @@ def build_round_program(
             adj = _edges_mask_both(adj, fin)
         else:
             finite = None
+        bcast_finite = None
         if attack_apply is not None:
             # Cast back: float32 attack noise must not promote the exchanged
             # [N, P] tensor when params are stored bfloat16 (tpu.param_dtype).
@@ -564,6 +625,7 @@ def build_round_program(
                 # `quarantined` (which implies a rollback) so the
                 # containment is visible in history, not silent.
                 bfin = jnp.isfinite(bcast).all(axis=1)
+                bcast_finite = bfin
                 bcast = jnp.where(bfin[:, None], bcast, own_flat)
                 adj = _edges_mask_sender(adj, bfin.astype(adj.dtype))
                 fault_stats["attack_scrubbed"] = (
@@ -587,13 +649,53 @@ def build_round_program(
         compress_stats = {}
         if compression is not None:
             with jax.named_scope("murmura.compress"):
+                # With staleness armed the rule consumes the receiver-side
+                # dequantized tensor even for quantized_exchange rules: the
+                # cache stores (and substitutes) one decoded [N, P] row per
+                # sender, and a fresh/stale row mix cannot be expressed
+                # inside one Int8Blocks payload.  Wire bytes are unchanged
+                # — the codec still runs — but the MUR700 s8-collective
+                # property is a stale-off contract (docs/PERFORMANCE.md).
                 bcast, _decoded, comp_updates, compress_stats = (
                     compress_exchange(
                         compression, bcast, agg_state,
-                        agg.quantized_exchange,
+                        agg.quantized_exchange and stale_fold is None,
                     )
                 )
             agg_state = {**agg_state, **comp_updates}
+
+        # 2d. bounded-staleness fold (core/stale.py; docs/ROBUSTNESS.md):
+        # between scrub and aggregation, disrupted senders' base-graph
+        # edges are re-added with the cached payload while its age stays
+        # within the bound.  scrub_ok taint-kills a caught row's cached
+        # copy for the round (MUR1103) — quarantine and attack-scrub
+        # apply to cached rows exactly as to fresh ones.
+        stale_stats = {}
+        if stale_fold is not None:
+            with jax.named_scope("murmura.stale"):
+                scrub_ok = jnp.ones_like(compromised)
+                if finite is not None:
+                    scrub_ok = scrub_ok * finite.astype(jnp.float32)
+                if bcast_finite is not None:
+                    scrub_ok = scrub_ok * bcast_finite.astype(jnp.float32)
+                # Receiver eligibility mirrors the fresh-exchange folds:
+                # dead receivers (alive) and quarantined ones (finite —
+                # _edges_mask_both zeroed their edges BOTH ways) get no
+                # re-added stale in-edges.  bcast_finite does NOT gate
+                # the receiver side: an attack-scrubbed sender still
+                # aggregates normally (_edges_mask_sender).
+                recv_ok = (
+                    alive if alive is not None
+                    else jnp.ones_like(compromised)
+                )
+                if finite is not None:
+                    recv_ok = recv_ok * finite.astype(jnp.float32)
+                bcast, adj, stale_updates, stale_stats = stale_fold(
+                    bcast, adj,
+                    {k: agg_state[k] for k in STALE_STATE_KEYS},
+                    recv_ok, scrub_ok,
+                )
+            agg_state = {**agg_state, **stale_updates}
 
         step_ctx = AggContext(
             apply_fn=ctx.apply_fn,
@@ -635,6 +737,8 @@ def build_round_program(
 
         # 3. adjacency-masked aggregation (network.py:121-139)
         reserved = set(DMTT_STATE_KEYS) | set(COMPRESS_STATE_KEYS)
+        if stale_fold is not None:
+            reserved |= set(STALE_STATE_KEYS)
         if adaptive:
             reserved |= set(attack.state_keys)
         rule_state = {
@@ -689,6 +793,7 @@ def build_round_program(
         metrics.update({f"agg_{k}": v for k, v in dmtt_stats.items()})
         metrics.update({f"agg_{k}": v for k, v in fault_stats.items()})
         metrics.update({f"agg_{k}": v for k, v in compress_stats.items()})
+        metrics.update({f"agg_{k}": v for k, v in stale_stats.items()})
         metrics.update({f"agg_{k}": v for k, v in attack_round_stats.items()})
         return params, agg_state, metrics
 
@@ -732,6 +837,23 @@ def build_round_program(
         init_agg_state.update(
             init_compress_state(compression, init_flat, init_flat.dtype)
         )
+    if staleness is not None:
+        # The payload cache + age stamps ride agg_state under the
+        # reserved STALE_STATE_KEYS slice — same [N, P]/[N] shapes and
+        # dtypes every round, so the scan carry, gang vmap, donation
+        # aliases and durability snapshots all hold without special
+        # cases (the COMPRESS_STATE_KEYS story).
+        clash = set(STALE_STATE_KEYS) & set(init_agg_state)
+        if clash:
+            raise ValueError(
+                f"aggregator '{agg.name}' carries state keys "
+                f"{sorted(clash)} reserved for the bounded-staleness "
+                "exchange"
+            )
+        leaf = jax.tree_util.tree_leaves(init_params)[0]
+        init_agg_state.update(
+            init_stale_state(staleness, n, model_dim, leaf.dtype)
+        )
     if adaptive:
         # Adaptation state rides agg_state under the attack's reserved
         # ATTACK_STATE_KEYS slice — same shapes/dtypes every round, so the
@@ -764,6 +886,7 @@ def build_round_program(
         sparse_offsets=sparse_offsets,
         compression=compression,
         adaptive_attack=adaptive,
+        staleness=staleness,
     )
 
 

@@ -69,7 +69,14 @@ class FaultSchedule:
             :meth:`masked_adjacency`) but it still receives and aggregates
             — the deadline-based partial-aggregation semantics of the
             distributed backend (node_process.py), applied to the jitted
-            backends.
+            backends.  With bounded staleness armed
+            (``exchange.max_staleness``, core/stale.py) the schedule
+            becomes a DELAY model instead of a pure drop: receivers
+            aggregate the straggler's last delivered payload at age >= 1
+            until the bound expires — the jitted twin of the ZMQ
+            backend's "physically late, may deliver next window"
+            behavior, closing the documented semantic gap between the
+            two realizations (docs/ROBUSTNESS.md "Bounded staleness").
         straggler_factor: Training-time multiplier the distributed backend
             uses to *realize* a straggle as an actual delay (sleep); the
             jitted backends only consume the boolean.
@@ -185,6 +192,21 @@ class FaultSchedule:
         fused-dispatch twin of the orchestrator's adj_stack."""
         self._ensure(round0 + k - 1)
         return np.stack([self._alive[round0 + i] for i in range(k)])
+
+    def delivering_at(self, round_idx: int) -> np.ndarray:
+        """[N] float32: senders whose round-``round_idx`` payload meets
+        the delivery deadline under the schedule's own masks (alive and
+        not straggling).  The host-side view of the stale layer's
+        delivery inference — an APPROXIMATION of it: core/stale.py
+        infers delivery from the fully-folded adjacency, so in-jit
+        sentinels (quarantine/scrub) and total link isolation can veto
+        senders this method reports as delivering.  Consumed by
+        bench_breakdown's staleness cells as the schedule-side count
+        next to the observed in-jit stale-edge counts."""
+        self._ensure(round_idx)
+        return self._alive[round_idx] * (
+            1.0 - self._straggle[round_idx].astype(np.float32)
+        )
 
     def masked_adjacency(self, adj: np.ndarray, round_idx: int) -> np.ndarray:
         """Fold this round's faults into an adjacency mask.

@@ -51,12 +51,14 @@ _MIN_STRENGTH = 1e-3
 
 @dataclass
 class FrontierCell:
-    """One (rule, attack, topology) cell's accumulated results."""
+    """One (rule, attack, topology[, percentage]) cell's accumulated
+    results."""
 
     rule: str
     attack: str
     topology: str
     degree: int
+    percentage: Optional[float] = None
     # strength -> list of per-seed records
     curve: Dict[float, Dict[str, Any]] = field(default_factory=dict)
     benign_accuracy: float = float("nan")
@@ -77,6 +79,7 @@ def _cell_config(
     attack: str,
     topology: str,
     members: Optional[List[Dict[str, Any]]] = None,
+    percentage: Optional[float] = None,
 ) -> Config:
     """Derive one cell's runnable config from the base experiment.
 
@@ -99,7 +102,15 @@ def _cell_config(
         ),
     }
     base_attack = config.attack
-    pct = base_attack.percentage if base_attack.enabled else 0.25
+    if percentage is not None:
+        # The breakdown-point axis (frontier.percentages): this cell runs
+        # with an explicit compromised fraction.  Each percentage is its
+        # own gang bucket — the compromised set is a static attack
+        # closure, so it cannot vary inside one compiled bucket the way
+        # the strength grid does.
+        pct = float(percentage)
+    else:
+        pct = base_attack.percentage if base_attack.enabled else 0.25
     params: Dict[str, Any] = {}
     if attack == "gaussian":
         params["noise_std"] = float(
@@ -204,10 +215,11 @@ def run_cell(
     topology: str,
     seeds: Sequence[int],
     progress: Optional[Callable[[str], None]] = None,
+    percentage: Optional[float] = None,
 ) -> FrontierCell:
-    """Run one (rule, attack, topology) cell: stage-0 grid, then
-    successive-halving refinement around the cliff, all on one gang
-    bucket with value-only resets between stages."""
+    """Run one (rule, attack, topology[, percentage]) cell: stage-0
+    grid, then successive-halving refinement around the cliff, all on
+    one gang bucket with value-only resets between stages."""
     from murmura_tpu.analysis.sanitizers import track_compiles
     from murmura_tpu.core.gang import GangMember
     from murmura_tpu.utils.factories import build_gang_from_config
@@ -218,6 +230,7 @@ def run_cell(
     cfg = _cell_config(
         config, f, rule, attack, topology,
         members=_members_for(strengths, seeds),
+        percentage=percentage,
     )
     rounds = cfg.experiment.rounds
     gang = build_gang_from_config(cfg, retain_init=True)
@@ -227,7 +240,11 @@ def run_cell(
         degree = int(np.asarray(gang.topology.mask()).sum(axis=1).max())
 
     cell = FrontierCell(
-        rule=rule, attack=attack, topology=topology, degree=degree
+        rule=rule, attack=attack, topology=topology, degree=degree,
+        percentage=(
+            float(percentage) if percentage is not None
+            else float(cfg.attack.percentage)
+        ),
     )
 
     def run_stage(stage: int, stage_strengths: Sequence[float]) -> None:
@@ -353,44 +370,57 @@ def run_frontier(
         )
     seeds = list(f.seeds) if f.seeds is not None else [config.experiment.seed]
 
+    # The breakdown-point axis (frontier.percentages): each compromised
+    # fraction runs the full strength x seed successive-halving search as
+    # its own compile-compatible bucket.  None = the base attack fraction
+    # only (the pre-axis behavior; the artifact still records which).
+    percentages: List[Optional[float]] = (
+        [float(p) for p in f.percentages]
+        if f.percentages is not None else [None]
+    )
+
     cells: List[Dict[str, Any]] = []
     for rule in f.rules:
         for attack in f.attacks:
             for topology in f.topologies:
-                say(f"cell {rule} x {attack} x {topology}")
-                cell = run_cell(
-                    config, f, rule, attack, topology, seeds,
-                    progress=progress,
-                )
-                last_held, first_broken, thr = _locate_break(
-                    cell.curve, cell.benign_accuracy, f.break_fraction
-                )
-                curve_rows = [
-                    {"strength": g, **rec}
-                    for g, rec in sorted(cell.curve.items())
-                ]
-                cells.append({
-                    "rule": rule,
-                    "attack": attack,
-                    "topology": topology,
-                    "degree": cell.degree,
-                    "benign_accuracy": cell.benign_accuracy,
-                    "curve": curve_rows,
-                    "breaking_point": {
-                        "last_held": last_held,
-                        "first_broken": first_broken,
-                        "threshold_accuracy": thr,
-                        "criterion": (
-                            f"mean honest accuracy < {f.break_fraction} x "
-                            "benign (0-strength) accuracy"
+                for pct in percentages:
+                    pct_label = "" if pct is None else f" x pct={pct:g}"
+                    say(f"cell {rule} x {attack} x {topology}{pct_label}")
+                    cell = run_cell(
+                        config, f, rule, attack, topology, seeds,
+                        progress=progress, percentage=pct,
+                    )
+                    last_held, first_broken, thr = _locate_break(
+                        cell.curve, cell.benign_accuracy, f.break_fraction
+                    )
+                    curve_rows = [
+                        {"strength": g, **rec}
+                        for g, rec in sorted(cell.curve.items())
+                    ]
+                    cells.append({
+                        "rule": rule,
+                        "attack": attack,
+                        "topology": topology,
+                        "percentage": cell.percentage,
+                        "degree": cell.degree,
+                        "benign_accuracy": cell.benign_accuracy,
+                        "curve": curve_rows,
+                        "breaking_point": {
+                            "last_held": last_held,
+                            "first_broken": first_broken,
+                            "threshold_accuracy": thr,
+                            "criterion": (
+                                f"mean honest accuracy < "
+                                f"{f.break_fraction} x benign "
+                                "(0-strength) accuracy"
+                            ),
+                        },
+                        "declared_influence": declared_influence(
+                            rule, cell.degree
                         ),
-                    },
-                    "declared_influence": declared_influence(
-                        rule, cell.degree
-                    ),
-                    "stages": cell.stages_run,
-                    "compiles": cell.compiles,
-                })
+                        "stages": cell.stages_run,
+                        "compiles": cell.compiles,
+                    })
 
     return {
         "schema_version": FRONTIER_SCHEMA_VERSION,
@@ -400,6 +430,9 @@ def run_frontier(
             "rules": list(f.rules),
             "attacks": list(f.attacks),
             "topologies": list(f.topologies),
+            "percentages": (
+                list(f.percentages) if f.percentages is not None else None
+            ),
             "seeds": seeds,
             "points": f.points,
             "stages": f.stages,
@@ -448,6 +481,9 @@ def frontier_break_summary(artifact: Dict[str, Any]) -> List[Dict[str, Any]]:
             "rule": c.get("rule"),
             "attack": c.get("attack"),
             "topology": c.get("topology"),
+            # Pre-percentage-axis artifacts (schema v1 before ISSUE 13)
+            # have no percentage field; render as unknown, not 0.
+            "percentage": c.get("percentage"),
             "degree": c.get("degree"),
             "benign_accuracy": c.get("benign_accuracy"),
             "last_held": bp.get("last_held"),

@@ -135,6 +135,16 @@ def build_report(run_dir) -> Dict[str, Any]:
     if taps:
         report["taps"] = taps
 
+    # ---- bounded staleness (core/stale.py; docs/ROBUSTNESS.md) ----------
+    # ``agg_tap_stale_used`` counts, per round, how many of node i's
+    # in-edges were served from the payload cache; ``agg_tap_stale_age``
+    # is the age of each SERVED sender's cached payload (0 = fresh or
+    # unserved).  The histogram answers "how stale did the exchange
+    # actually run" next to the configured max_staleness bound.
+    stale = _stale_report(rounds)
+    if stale:
+        report["staleness"] = stale
+
     # ---- declared influence contract ------------------------------------
     # The rule's InfluenceDecl (aggregation/base.py; verified statically by
     # `murmura check --flow` MUR800-802) doubles as runtime documentation:
@@ -196,6 +206,31 @@ def _per_node_sum(rounds: List[dict], key: str) -> Optional[List[float]]:
         for i, v in enumerate(r):
             if isinstance(v, (int, float)) and math.isfinite(v):
                 out[i] += v
+    return out
+
+
+def _stale_report(rounds: List[dict]) -> Optional[Dict[str, Any]]:
+    """Per-node stale-edge totals + the served-age histogram from the
+    bounded-staleness audit taps (agg_tap_stale_used / agg_tap_stale_age
+    — core/stale.py)."""
+    used = _per_node_sum(rounds, "agg_tap_stale_used")
+    if used is None:
+        return None
+    out: Dict[str, Any] = {
+        "stale_in_edges": used,
+        "total_stale_edges": sum(used),
+    }
+    hist: Dict[str, int] = {}
+    for e in rounds:
+        metrics = e.get("metrics")
+        row = metrics.get("agg_tap_stale_age") if isinstance(metrics, dict) else None
+        if not isinstance(row, list):
+            continue
+        for a in row:
+            if isinstance(a, (int, float)) and math.isfinite(a) and a > 0:
+                hist[str(int(a))] = hist.get(str(int(a)), 0) + 1
+    if hist:
+        out["age_histogram"] = dict(sorted(hist.items(), key=lambda kv: int(kv[0])))
     return out
 
 
@@ -284,11 +319,31 @@ def render_report(run_dir, console=None) -> Dict[str, Any]:
             f"  [cyan]declared influence[/cyan] ({inf['rule']}): "
             f"{inf['declared']}"
         )
-    if "taps" in report or "faults" in report:
+    if "staleness" in report:
+        stale = report["staleness"]
+        hist = stale.get("age_histogram") or {}
+        hist_txt = (
+            "  ages " + "  ".join(
+                f"{a}r:{c}" for a, c in hist.items()
+            )
+            if hist else ""
+        )
+        console.print(
+            f"  [cyan]bounded staleness[/cyan]: "
+            f"{_fmt(stale['total_stale_edges'], 0)} stale edge-serves "
+            f"over recorded rounds{hist_txt}"
+        )
+    if "taps" in report or "faults" in report or "staleness" in report:
         taps = report.get("taps") or {}
         faults = report.get("faults") or {}
+        stale_cols = {
+            k: v for k, v in (report.get("staleness") or {}).items()
+            if k == "stale_in_edges"
+        }
         n = max(
-            [len(v) for v in taps.values()] + [len(v) for v in faults.values()]
+            [len(v) for v in taps.values()]
+            + [len(v) for v in faults.values()]
+            + [len(v) for v in stale_cols.values()]
         )
         t = Table(title="Per-node audit (totals over recorded rounds)")
         t.add_column("node", justify="right")
@@ -297,6 +352,7 @@ def render_report(run_dir, console=None) -> Dict[str, Any]:
             ("selected_by", taps), ("considered_by", taps),
             ("rejections", taps), ("quarantined_rounds", faults),
             ("scrubbed_rounds", faults), ("alive_rounds", faults),
+            ("stale_in_edges", stale_cols),
         ):
             if key in src:
                 t.add_column(key, justify="right")
@@ -369,6 +425,7 @@ def render_frontier(artifact: Dict[str, Any], console=None) -> None:
     t.add_column("rule", style="cyan")
     t.add_column("attack")
     t.add_column("topology")
+    t.add_column("pct", justify="right")
     t.add_column("deg", justify="right")
     t.add_column("benign acc", justify="right")
     t.add_column("held ≤", justify="right")
@@ -386,8 +443,10 @@ def render_frontier(artifact: Dict[str, Any], console=None) -> None:
             else f"bounded ≤ {row['declared_bound']}" if kind == "bounded"
             else str(kind)
         )
+        pct = row.get("percentage")
         t.add_row(
             str(row["rule"]), str(row["attack"]), str(row["topology"]),
+            "-" if pct is None else f"{pct:g}",
             str(row["degree"]), _fmt(row["benign_accuracy"], 3),
             "-" if held is None else f"{held:.3g}",
             "[bold red]never[/bold red]" if broken is None

@@ -157,12 +157,29 @@ def build_attack(config: Config) -> Optional[Attack]:
                 seed=seed,
                 estimator=estimator,
             )
-        return _bisect(ATTACKS["ipm"](
+        if ad.enabled:
+            # IPM adapts its own semantic knob — the negation factor
+            # epsilon walks the acceptance signal as carried state
+            # (atk_eps) — rather than riding the generic perturbation
+            # bisection: the converged strength then lives on the
+            # paper's epsilon axis (attacks/adaptive.py).
+            from murmura_tpu.attacks.adaptive import make_adaptive_ipm_attack
+
+            return make_adaptive_ipm_attack(
+                num_nodes=n,
+                attack_percentage=pct,
+                epsilon=p.get("epsilon"),
+                seed=seed,
+                eta=ad.eta,
+                accept_target=ad.accept_target,
+                ema_beta=ad.ema_beta,
+            )
+        return ATTACKS["ipm"](
             num_nodes=n,
             attack_percentage=pct,
             epsilon=p.get("epsilon"),
             seed=seed,
-        ))
+        )
     if config.attack.type == "label_flip":
         if config.backend == "distributed":
             # The ZMQ NodeProcess builds its own data shard; the poison
@@ -317,6 +334,36 @@ def build_compression_spec(config: Config):
         block=c.block,
         topk_ratio=c.topk_ratio,
         error_feedback=c.error_feedback,
+    )
+
+
+def build_staleness_spec(config: Config, topology):
+    """Trace-time StalenessSpec from config.exchange, or None when off —
+    the single construction path for every consumer (single runs and
+    gangs), so the base-graph/age semantics cannot drift between them.
+
+    The base mask is the UNFAULTED exchange graph re-added stale edges
+    are drawn from: the topology's static [N, N] mask (dense mode) or
+    the all-active [k, N] edge mask (the static sparse exponential
+    family; one_peer's round-varying mask was rejected at schema
+    validation).
+    """
+    e = config.exchange
+    if e.max_staleness <= 0:
+        return None
+    from murmura_tpu.core.stale import StalenessSpec
+    from murmura_tpu.topology.sparse import SparseTopology
+
+    if isinstance(topology, SparseTopology):
+        base = np.ones(
+            (len(topology.offsets), topology.num_nodes), np.float32
+        )
+    else:
+        base = np.asarray(topology.mask(), dtype=np.float32)
+    return StalenessSpec(
+        max_staleness=e.max_staleness,
+        discount=e.staleness_discount,
+        base_mask=base,
     )
 
 
@@ -654,6 +701,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             hp_inputs=hp_inputs,
             sparse_offsets=tuple(topology.offsets) if sparse else None,
             compression=build_compression_spec(config),
+            staleness=build_staleness_spec(config, topology),
         ))
 
     writers = None
@@ -872,6 +920,7 @@ def build_network_from_config(
         audit_taps=config.telemetry.audit_taps,
         sparse_offsets=tuple(topology.offsets) if sparse else None,
         compression=build_compression_spec(config),
+        staleness=build_staleness_spec(config, topology),
     )
 
     if config.backend == "tpu" and mesh is None:

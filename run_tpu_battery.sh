@@ -16,6 +16,7 @@ POPULATION=0
 COMPRESS=0
 RESUME=0
 FRONTIER=0
+STALE=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -25,6 +26,7 @@ while :; do
     --compress) COMPRESS=1; shift;;
     --resume) RESUME=1; shift;;
     --frontier) FRONTIER=1; shift;;
+    --stale) STALE=1; shift;;
     *) break;;
   esac
 done
@@ -254,6 +256,89 @@ PYEOF
     exit 1
   fi
   echo "preflight frontier clean" | tee -a "$OUT/battery.log"
+fi
+# Optional staleness pre-flight (./run_tpu_battery.sh --stale [outdir]):
+# the ISSUE-13 gates — a krum run under a 30% straggler + 30% link-drop
+# schedule on non-IID shards with bounded staleness armed must (a) run
+# with ZERO post-warmup recompiles under tpu.recompile_guard (the cache
+# and ages are carried state, the fault masks input values — MUR1101),
+# (b) actually serve stale edges (a dead stale layer would pass every
+# accuracy bar vacuously), and (c) recover at least HALF the accuracy
+# gap between the fault-free and drop-sync-faulted baselines — the
+# acceptance bar of docs/ROBUSTNESS.md "Bounded staleness".  CPU-pinned
+# like the static gate.
+if [ "${STALE:-0}" = 1 ]; then
+  echo "=== preflight: bounded-staleness recovery (stale-on vs stale-off vs fault-free) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 900 env JAX_PLATFORMS=cpu python - > "$OUT/preflight_stale.out" 2>&1 <<'PYEOF'
+import sys
+
+import numpy as np
+
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+
+ROUNDS = 12
+
+
+def run(faults=None, exchange=None):
+    raw = {
+        "experiment": {"name": "stale-preflight", "seed": 3,
+                       "rounds": ROUNDS},
+        "topology": {"type": "k-regular", "num_nodes": 8, "k": 4},
+        "aggregation": {"algorithm": "krum"},
+        "training": {"local_epochs": 1, "batch_size": 16, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 240, "input_dim": 16,
+                            "num_classes": 8,
+                            "partition_method": "dirichlet",
+                            "alpha": 0.3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 16, "hidden_dims": [16],
+                             "num_classes": 8}},
+        "backend": "simulation",
+        # recompile_guard arms CompileTracker inside the round loop: any
+        # compile after warmup raises instead of silently re-lowering.
+        "tpu": {"recompile_guard": True, "num_devices": 1,
+                "compute_dtype": "float32"},
+    }
+    if faults:
+        raw["faults"] = faults
+    if exchange:
+        raw["exchange"] = exchange
+    h = build_network_from_config(Config.model_validate(raw)).train(
+        rounds=ROUNDS
+    )
+    return h, float(np.mean(h["mean_accuracy"][-2:]))
+
+
+FAULTS = {"enabled": True, "straggler_prob": 0.3, "link_drop_prob": 0.3,
+          "seed": 11}
+_, acc_clean = run()
+_, acc_drop = run(faults=FAULTS)
+h_stale, acc_stale = run(faults=FAULTS, exchange={"max_staleness": 2})
+gap = acc_clean - acc_drop
+recovered = acc_stale - acc_drop
+print(f"clean={acc_clean:.4f} drop-sync={acc_drop:.4f} "
+      f"stale={acc_stale:.4f} gap={gap:.4f} recovered={recovered:.4f}")
+served = sum(h_stale.get("agg_stale_used", []))
+print(f"stale edge-serves: {served}")
+if served <= 0:
+    print("FAIL: the stale layer served zero edges under a 30% "
+          "straggler/link-drop schedule — the accuracy comparison is "
+          "vacuous")
+    sys.exit(1)
+if gap > 0.01 and recovered < 0.5 * gap:
+    print(f"FAIL: staleness recovered {recovered:.4f} of a {gap:.4f} "
+          "accuracy gap — the acceptance bar is >= half")
+    sys.exit(1)
+print("stale preflight ok (zero post-warmup recompiles by guard)")
+PYEOF
+  then
+    echo "preflight stale FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_stale.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight stale clean" | tee -a "$OUT/battery.log"
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

@@ -605,7 +605,9 @@ class TestAdaptiveContracts:
 
     @pytest.mark.parametrize("kind", list(ADAPTIVE_ATTACK_KINDS))
     def test_mur1003_containment_clean(self, kind):
-        name = "adaptive_alie" if kind == "alie" else "bisection"
+        name = {"alie": "adaptive_alie", "ipm": "adaptive_ipm"}.get(
+            kind, "bisection"
+        )
         assert containment_findings(name, _build_adaptive(kind, 8)) == []
 
     def test_mur1003_fires_on_leaky_feedback(self):
@@ -628,7 +630,9 @@ class TestAdaptiveContracts:
         assert adaptive_influence_findings(rule, "alie") == []
 
     def test_adaptive_attacks_registered(self):
-        assert set(ADAPTIVE_ATTACKS) == {"adaptive_alie", "bisection"}
+        assert set(ADAPTIVE_ATTACKS) == {
+            "adaptive_alie", "adaptive_ipm", "bisection"
+        }
 
     @pytest.mark.slow
     def test_full_grid_clean(self):
@@ -780,3 +784,181 @@ class TestFrontierRun:
         p.write_text(json.dumps({"hello": 1}))
         with pytest.raises(ValueError, match="not a frontier artifact"):
             load_frontier(p)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive IPM: epsilon as carried state (ISSUE 13 satellite — the PR 11
+# follow-up named in ROADMAP item 4's remaining list)
+# ---------------------------------------------------------------------------
+
+
+class TestAdaptiveIpm:
+    def _attack(self, n=6, pct=0.34, **kw):
+        from murmura_tpu.attacks.adaptive import make_adaptive_ipm_attack
+
+        return make_adaptive_ipm_attack(n, pct, seed=0, **kw)
+
+    def test_epsilon_walks_with_acceptance(self):
+        atk = self._attack(epsilon=1.0, eta=0.25)
+        n = 6
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        ones = jnp.ones(n)
+        state = {
+            k: jnp.asarray(v) for k, v in atk.init_attack_state(n).items()
+        }
+        ci = np.flatnonzero(atk.compromised)[0]
+        state = atk.update_attack_state(state, ones, ones, comp)
+        assert np.asarray(state["atk_eps"])[ci] == pytest.approx(1.25)
+        state = atk.update_attack_state(state, jnp.zeros(n), ones, comp)
+        assert np.asarray(state["atk_eps"])[ci] == pytest.approx(0.9375)
+        # Honest rows never move.
+        hi = np.flatnonzero(~(atk.compromised > 0))[0]
+        assert np.asarray(state["atk_eps"])[hi] == pytest.approx(1.0)
+
+    def test_unobserved_rows_freeze(self):
+        atk = self._attack(epsilon=1.0)
+        n = 6
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        state = {
+            k: jnp.asarray(v) for k, v in atk.init_attack_state(n).items()
+        }
+        before = np.asarray(state["atk_eps"]).copy()
+        state = atk.update_attack_state(
+            state, jnp.ones(n), jnp.zeros(n), comp
+        )
+        np.testing.assert_array_equal(np.asarray(state["atk_eps"]), before)
+
+    def test_apply_negates_honest_mean_per_row(self):
+        atk = self._attack(epsilon=2.0)
+        n = 6
+        comp = jnp.asarray(atk.compromised.astype(np.float32))
+        rng = np.random.default_rng(0)
+        flat = jnp.asarray(rng.normal(size=(n, 5)).astype(np.float32))
+        state = {
+            k: jnp.asarray(v) for k, v in atk.init_attack_state(n).items()
+        }
+        out = np.asarray(
+            atk.apply_adaptive(flat, comp, jax.random.PRNGKey(0), 0.0, state)
+        )
+        honest = np.asarray(comp) == 0
+        mu = np.asarray(flat)[honest].mean(axis=0)
+        for i in np.flatnonzero(atk.compromised):
+            np.testing.assert_allclose(out[i], -2.0 * mu, rtol=1e-5)
+        np.testing.assert_array_equal(out[honest], np.asarray(flat)[honest])
+
+    def test_factories_wire_ipm_adaptive(self):
+        from murmura_tpu.attacks.adaptive import AdaptiveAttack
+        from murmura_tpu.utils.factories import build_attack
+
+        cfg = _cfg(attack={"enabled": True, "type": "ipm",
+                           "percentage": 0.3,
+                           "adaptive": {"enabled": True}})
+        atk = build_attack(cfg)
+        assert isinstance(atk, AdaptiveAttack)
+        assert atk.name == "adaptive_ipm"
+        assert set(atk.state_keys) == {"atk_accept_ema", "atk_eps"}
+
+    def test_run_escalates_against_tapless_rule(self):
+        # fedavg emits no selection taps: the attacker reads constant
+        # acceptance and epsilon must escalate toward its cap.
+        cfg = _cfg(aggregation={"algorithm": "fedavg"},
+                   attack={"enabled": True, "type": "ipm",
+                           "percentage": 0.3,
+                           "adaptive": {"enabled": True}})
+        net = build_network_from_config(cfg)
+        net.train(rounds=3)
+        comp = np.asarray(net.compromised) > 0
+        eps = np.asarray(net.agg_state["atk_eps"])
+        from murmura_tpu.attacks.ipm import DEFAULT_EPSILON
+
+        assert (eps[comp] > DEFAULT_EPSILON).all()
+
+    def test_run_retreats_against_krum(self):
+        cfg = _cfg(attack={"enabled": True, "type": "ipm",
+                           "percentage": 0.3,
+                           "adaptive": {"enabled": True}})
+        net = build_network_from_config(cfg)
+        net.train(rounds=4)
+        comp = np.asarray(net.compromised) > 0
+        eps = np.asarray(net.agg_state["atk_eps"])
+        from murmura_tpu.attacks.ipm import DEFAULT_EPSILON
+
+        # Krum rejects the negated mean outright: epsilon ducks below
+        # its starting strength toward the stealth regime.
+        assert (eps[comp] < DEFAULT_EPSILON).all()
+        assert "agg_atk_eps" in net.history
+
+
+# ---------------------------------------------------------------------------
+# Frontier percentage axis (ISSUE 13 satellite — the breakdown-point axis)
+# ---------------------------------------------------------------------------
+
+
+class TestFrontierPercentages:
+    def test_percentages_validators(self):
+        with pytest.raises(Exception, match="distinct"):
+            _cfg(frontier={"percentages": [0.2, 0.2]})
+        with pytest.raises(Exception, match="non-empty"):
+            _cfg(frontier={"percentages": []})
+        with pytest.raises(Exception, match=r"\(0, 1\)"):
+            _cfg(frontier={"percentages": [0.2, 1.5]})
+
+    def test_cell_config_overrides_percentage(self):
+        from murmura_tpu.frontier import _cell_config
+
+        cfg = _cfg(frontier={"percentages": [0.2, 0.45]})
+        cell = _cell_config(cfg, cfg.frontier, "krum", "gaussian", "dense",
+                            percentage=0.45)
+        assert cell.attack.percentage == 0.45
+
+    def test_percentage_axis_end_to_end(self, tmp_path):
+        from murmura_tpu.frontier import (
+            frontier_break_summary,
+            run_frontier,
+        )
+
+        cfg = _cfg(
+            experiment={"name": "frontier-pct", "seed": 7, "rounds": 2},
+            frontier={"rules": ["krum"], "attacks": ["gaussian"],
+                      "topologies": ["dense"], "points": 2, "stages": 1,
+                      "rounds": 2, "strength_lo": 0.5, "strength_hi": 4.0,
+                      "percentages": [0.2, 0.45]},
+        )
+        artifact = run_frontier(cfg)
+        cells = artifact["cells"]
+        assert [c["percentage"] for c in cells] == [0.2, 0.45]
+        assert artifact["grid"]["percentages"] == [0.2, 0.45]
+        # Each percentage is its own bucket: both charted, both with
+        # curves and declared bounds.
+        for c in cells:
+            assert c["curve"] and c["declared_influence"]
+        rows = frontier_break_summary(artifact)
+        assert [r["percentage"] for r in rows] == [0.2, 0.45]
+
+    def test_render_includes_percentage_column(self, tmp_path):
+        from rich.console import Console
+
+        from murmura_tpu.telemetry.report import render_frontier
+
+        # A minimal synthetic artifact exercises the renderer without a
+        # training run; an old-schema cell (no percentage) renders "-".
+        artifact = {
+            "experiment": "x", "grid": {},
+            "cells": [{
+                "rule": "krum", "attack": "gaussian", "topology": "dense",
+                "percentage": 0.45, "degree": 4, "benign_accuracy": 0.9,
+                "curve": [], "breaking_point": {}, "stages": 1,
+                "compiles": 1,
+                "declared_influence": {"kind": "bounded", "bound": 1,
+                                       "describe": "bounded"},
+            }, {
+                "rule": "median", "attack": "gaussian",
+                "topology": "dense", "degree": 4, "benign_accuracy": 0.9,
+                "curve": [], "breaking_point": {}, "stages": 1,
+                "compiles": 1, "declared_influence": None,
+            }],
+        }
+        console = Console(record=True, width=220)
+        render_frontier(artifact, console=console)
+        text = console.export_text()
+        assert "0.45" in text and "pct" in text

@@ -421,6 +421,41 @@ class TestCrashMatrix:
         )
         _assert_same_run(full, resumed, "adaptive@r2")
 
+    def test_adaptive_ipm_epsilon_survives(self):
+        # Adaptive IPM's negation factor (atk_eps, ATTACK_STATE_KEYS —
+        # the PR 11 follow-up) is round-crossing state: killing
+        # mid-walk and dropping it would resume the attacker at the
+        # paper-default epsilon instead of its converged strength.
+        over = {"attack": {"enabled": True, "type": "ipm",
+                           "percentage": 0.3,
+                           "adaptive": {"enabled": True}}}
+        full, resumed = _crash_resume(over, 2, 4)
+        assert "atk_eps" in full.agg_state, (
+            "the cell must actually carry the epsilon walk for this "
+            "test to mean anything"
+        )
+        _assert_same_run(full, resumed, "adaptive_ipm@r2")
+
+    def test_stale_cache_survives_populated(self):
+        # SIGKILL with a POPULATED stale cache (STALE_STATE_KEYS): a
+        # snapshot that dropped the payload cache or the age stamps
+        # would resume serving zeros as "cached" neighbor models, or
+        # re-serve expired ones.
+        over = {"faults": {"enabled": True, "straggler_prob": 0.4,
+                           "link_drop_prob": 0.2, "seed": 11},
+                "exchange": {"max_staleness": 2,
+                             "staleness_discount": 0.5}}
+        full, resumed = _crash_resume(over, 2, 4)
+        import numpy as np
+
+        from murmura_tpu.core.stale import STALE_STATE_KEYS
+
+        assert set(STALE_STATE_KEYS) <= set(full.agg_state)
+        # The kill point must actually have a populated cache, or the
+        # test silently degrades to the dense cell.
+        assert np.abs(np.asarray(full.agg_state["stale_cache"])).sum() > 0
+        _assert_same_run(full, resumed, "stale@r2")
+
     def test_int8_ef_carried_residual_survives(self):
         # The EF residual is round-crossing state: killing between rounds
         # and dropping it would silently decay compression accuracy.
@@ -450,6 +485,10 @@ class TestCrashMatrix:
                                     "percentage": 0.3,
                                     "params": {"noise_std": 5.0},
                                     "adaptive": {"enabled": True}}},
+            "stale": {"faults": {"enabled": True, "straggler_prob": 0.4,
+                                 "link_drop_prob": 0.2, "seed": 11},
+                      "exchange": {"max_staleness": 2,
+                                   "staleness_discount": 0.5}},
         }
         assert set(mode_over) == set(DURABILITY_MODES)
         for mode, over in mode_over.items():

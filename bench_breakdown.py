@@ -20,6 +20,12 @@ timing nested subsets of the round program on the bench configuration
                  under a 30% straggler + link-drop FaultSchedule, drop-
                  sync baseline vs max_staleness {1, 4}, with per-round
                  stale-edge counts committed in the manifest.
+    pipeline   — pipelined-rounds cells (ISSUE 14): krum serialized vs
+                 exchange.pipeline on dense k-regular(4) AND sparse
+                 exponential graphs, int8+EF off/on, committing the
+                 per-segment hidden fraction ((serialized - pipelined) /
+                 (serialized - train)) and the MFU delta per cell, each
+                 with its own platform stamp.
 
 Writes bench_breakdown.json (committed) and prints it.  Run on the real
 TPU (default env); the numbers anchor the MFU narrative in BENCH_r03.
@@ -135,7 +141,8 @@ PROBE_CFG = {
 }
 
 
-def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
+def build(algo: str, local_epochs: int, raw_cfg=None, compression=None,
+          pipeline: bool = False, sparse_topology=None):
     from murmura_tpu.aggregation import build_aggregator
     from murmura_tpu.aggregation.base import AggregatorDef
     from murmura_tpu.config import Config
@@ -157,6 +164,16 @@ def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
         cfg.data.adapter, cfg.data.params, num_nodes=n, seed=7
     )
     model = resolve_model(cfg, data)
+    # Sparse exchange mode (the pipeline cells' sparse-exponential
+    # column): rules take the [k, N] edge-mask engine, the program's
+    # adjacency input is the SparseTopology mask.
+    sparse_params = {}
+    offsets = None
+    if sparse_topology is not None:
+        offsets = tuple(sparse_topology.offsets)
+        sparse_params = {
+            "exchange_offsets": list(offsets), "sparse_exchange": True,
+        }
     if algo == "passthrough":
         agg = AggregatorDef(
             name="passthrough",
@@ -170,10 +187,14 @@ def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
             aggregate=lambda own, bcast, adj, r, state, ctx: (bcast, state, {}),
         )
     elif algo == "krum":
-        agg = build_aggregator(algo, {"num_compromised": 1, "max_candidates": 5})
+        agg = build_aggregator(
+            algo,
+            {"num_compromised": 1, "max_candidates": 5, **sparse_params},
+        )
     else:
         agg = build_aggregator(
-            algo, dict(cfg.aggregation.params), total_rounds=10
+            algo, {**cfg.aggregation.params, **sparse_params},
+            total_rounds=10,
         )
     attack = build_attack(cfg)
     probe_size = cfg.aggregation.params.get("max_eval_samples")
@@ -182,6 +203,8 @@ def build(algo: str, local_epochs: int, raw_cfg=None, compression=None):
         local_epochs=local_epochs, batch_size=32, lr=0.05, total_rounds=10,
         attack=attack, seed=7, probe_size=probe_size,
         compression=compression,
+        sparse_offsets=offsets,
+        pipeline=pipeline,
     )
     return program, attack
 
@@ -258,6 +281,132 @@ def _staleness_cells(nodes: int) -> dict:
                   f"{nodes}-node k-regular(4), fused dispatch with "
                   "per-round in-scan eval",
         "rounds": rounds,
+        "cells": cells,
+    }
+
+
+def _pipeline_cells(nodes: int) -> dict:
+    """Pipelined-rounds cells (ISSUE 14; docs/PERFORMANCE.md "Pipelined
+    rounds"): the krum scenario serialized vs ``exchange.pipeline``, on
+    the dense k-regular(4) graph AND the sparse exponential graph, with
+    the int8+EF codec off and on.  Each cell times three per-round
+    programs with the marginal chain method (``_timed_step``):
+
+        train     — passthrough-bcast (local SGD + attack + codec, no
+                    aggregation): the segment the pipeline hides behind;
+        serialized — the full krum round (train THEN exchange+aggregate
+                    on the critical path);
+        pipelined — the same round with the delayed double-buffered
+                    aggregation issued concurrently with training.
+
+    ``hidden_fraction`` = (serialized - pipelined) / (serialized -
+    train): 1.0 means the exchange+aggregate segment vanished from the
+    critical path entirely, 0.0 means nothing was hidden (a sequential
+    backend — XLA CPU — schedules the independent stages back-to-back,
+    so CPU smoke cells are a correctness capture, not an overlap
+    measurement; the >= 0.8 acceptance bar is a TPU gate).  Each cell
+    carries its own platform stamp, XLA flop count and the derived MFU
+    so the committed artifact records the MFU delta vs the serialized
+    baseline per point.
+    """
+    from murmura_tpu.analysis.budgets import normalize_cost_analysis
+    from murmura_tpu.topology.generators import create_topology
+
+    device_kind = jax.devices()[0].device_kind
+    try:
+        from bench import _peak_flops
+
+        peak = _peak_flops(device_kind)
+    except Exception:
+        peak = None
+
+    cells = {}
+    for topo_name in ("dense", "sparse_exponential"):
+        if topo_name == "dense":
+            topo = create_topology(
+                "k-regular", num_nodes=nodes, k=4, seed=12345
+            )
+            sparse_topo = None
+            adj = jnp.asarray(topo.mask())
+        else:
+            sparse_topo = create_topology(
+                "exponential", num_nodes=nodes, seed=12345
+            )
+            adj = jnp.asarray(sparse_topo.edge_mask(0))
+        raw = flagship_cfg(nodes)
+        if topo_name == "sparse_exponential":
+            import copy
+
+            raw = copy.deepcopy(raw)
+            raw["topology"] = {"type": "exponential", "num_nodes": nodes}
+        for codec_name, spec in (("codec_none", None), ("int8_ef", None)):
+            if codec_name == "int8_ef":
+                from murmura_tpu.ops.compress import CompressionSpec
+
+                spec = CompressionSpec(
+                    "int8", block=256, error_feedback=True
+                )
+            cell: dict = {**_platform_stamp(), "device_kind": device_kind}
+            ms = {}
+            for variant, algo, pipe in (
+                ("train", "passthrough_bcast", False),
+                ("serialized", "krum", False),
+                ("pipelined", "krum", True),
+            ):
+                program, attack = build(
+                    algo, 1, raw_cfg=raw, compression=spec,
+                    pipeline=pipe, sparse_topology=sparse_topo,
+                )
+                step = jax.jit(program.train_step)
+                d = {
+                    k: jnp.asarray(v)
+                    for k, v in program.data_arrays.items()
+                }
+                comp = jnp.asarray(attack.compromised.astype("float32"))
+                args = (
+                    program.init_params,
+                    {
+                        k: jnp.asarray(v)
+                        for k, v in program.init_agg_state.items()
+                    },
+                    jax.random.PRNGKey(0), adj, comp,
+                    jnp.asarray(0.0, jnp.float32), d,
+                )
+                ms[variant] = 1e3 * _timed_step(step, args)
+                cell[f"{variant}_ms"] = round(ms[variant], 3)
+                if algo == "krum":
+                    try:
+                        cost = normalize_cost_analysis(
+                            step.lower(*args).compile().cost_analysis()
+                        )
+                        flops = cost.get("flops")
+                    except Exception:
+                        flops = None
+                    cell[f"{variant}_flops"] = flops
+                    if flops and peak and ms[variant] > 0:
+                        cell[f"{variant}_mfu"] = round(
+                            flops / (ms[variant] / 1e3) / peak, 5
+                        )
+            seg = ms["serialized"] - ms["train"]
+            cell["exchange_aggregate_segment_ms"] = round(seg, 3)
+            if seg > 0:
+                cell["hidden_fraction"] = round(
+                    (ms["serialized"] - ms["pipelined"]) / seg, 4
+                )
+            if cell.get("serialized_mfu") and cell.get("pipelined_mfu"):
+                cell["mfu_delta"] = round(
+                    cell["pipelined_mfu"] - cell["serialized_mfu"], 5
+                )
+            cells[f"{topo_name}/{codec_name}"] = cell
+    return {
+        "config": f"krum serialized vs exchange.pipeline, {nodes} nodes, "
+                  "dense k-regular(4) + sparse exponential, int8+EF "
+                  "off/on; hidden_fraction = (serialized - pipelined) / "
+                  "(serialized - train)",
+        "acceptance": "exchange+aggregate segment >= 80% hidden behind "
+                      "local training on TPU (CPU schedules the stages "
+                      "sequentially; smoke cells are correctness "
+                      "captures)",
         "cells": cells,
     }
 
@@ -396,6 +545,10 @@ def main():
     # stale-edge counts committed in the manifest.
     stale_section = _staleness_cells(nodes)
 
+    # Pipelined-rounds cells (ISSUE 14): serialized vs exchange.pipeline
+    # with per-segment hidden fraction and the MFU delta.
+    pipeline_section = _pipeline_cells(nodes)
+
     if nodes != 20:
         # Scale runs measure only the flagship segments; the probe
         # scenario is scale-independent (its own 10-node config).
@@ -406,6 +559,7 @@ def main():
             "num_nodes": nodes,
             "segments": seg,
             "staleness": stale_section,
+            "pipeline": pipeline_section,
             "raw": results,
         }
         if SMOKE:
@@ -460,6 +614,7 @@ def main():
         **_platform_stamp(),
         "segments": seg,
         "staleness": stale_section,
+        "pipeline": pipeline_section,
         "probe_scenario": {
             "config": "evidential_trust, 10-node fully, UCI-HAR-shaped, "
                        "max_eval_samples=64",

@@ -43,6 +43,7 @@ def run_check_detailed(
     durability: Optional[bool] = None,
     adaptive: Optional[bool] = None,
     staleness: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -62,11 +63,17 @@ def run_check_detailed(
     (analysis/staleness.py, MUR1100-1103: stale-state registry
     bijection, zero recompiles across staleness variation,
     collective-inventory parity with the drop-sync program, and the
-    influence-bound/replay-hole taint runs over the staleness path).
+    influence-bound/replay-hole taint runs over the staleness path),
+    and when ``pipeline`` is enabled the pipelined-rounds contracts
+    (analysis/pipeline.py, MUR1200-1203: pipeline-state registry
+    bijection, zero recompiles across buffer swaps,
+    collective-inventory parity with the serialized program, and the
+    delayed-step influence/lagging-verdict taint runs).
     ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
-    ``staleness=None`` mean "on for the package check, off for explicit
-    paths" (all five passes are package-global: they exercise the live
-    registry, not the files named on the command line).
+    ``staleness=None``/``pipeline=None`` mean "on for the package
+    check, off for explicit paths" (all six passes are package-global:
+    they exercise the live registry, not the files named on the
+    command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -79,6 +86,7 @@ def run_check_detailed(
     run_durability = durability if durability is not None else not paths
     run_adaptive = adaptive if adaptive is not None else not paths
     run_staleness = staleness if staleness is not None else not paths
+    run_pipeline = pipeline if pipeline is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -110,6 +118,10 @@ def run_check_detailed(
         from murmura_tpu.analysis import staleness as staleness_mod
 
         findings.extend(staleness_mod.check_staleness())
+    if run_pipeline:
+        from murmura_tpu.analysis import pipeline as pipeline_mod
+
+        findings.extend(pipeline_mod.check_pipeline())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -122,12 +134,13 @@ def run_check(
     durability: Optional[bool] = None,
     adaptive: Optional[bool] = None,
     staleness: Optional[bool] = None,
+    pipeline: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
-        adaptive=adaptive, staleness=staleness,
+        adaptive=adaptive, staleness=staleness, pipeline=pipeline,
     )[0]
 
 

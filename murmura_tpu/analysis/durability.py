@@ -57,9 +57,14 @@ from murmura_tpu.analysis.lint import Finding
 # (ISSUE 13) runs the dense exchange under a straggler/link-drop fault
 # schedule with bounded staleness armed: the mode with round-crossing
 # STALE_STATE_KEYS state — a snapshot that dropped the payload cache
-# would resume serving zeros as "cached" neighbor models.
+# would resume serving zeros as "cached" neighbor models.  ``pipeline``
+# (ISSUE 14) runs the dense exchange with pipelined rounds armed: the
+# mode with round-crossing PIPELINE_STATE_KEYS state — a snapshot that
+# dropped the double buffer would resume with the in-flight round's
+# exchange silently discarded (the delayed displacement lost forever).
 DURABILITY_MODES: Tuple[str, ...] = (
-    "dense", "circulant", "sparse", "compressed", "adaptive", "stale"
+    "dense", "circulant", "sparse", "compressed", "adaptive", "stale",
+    "pipeline",
 )
 
 # Registry of check families in this module: name -> callable, scanned by
@@ -134,6 +139,11 @@ def _cell_config(rule: str, mode: str):
         raw["faults"] = {"enabled": True, "straggler_prob": 0.4,
                          "link_drop_prob": 0.2, "seed": 11}
         raw["exchange"] = {"max_staleness": 2, "staleness_discount": 0.5}
+    elif mode == "pipeline":
+        # Snapshot at round 2 => the pipeline buffer holds round 1's
+        # un-aggregated exchange; the resumed run must aggregate it on
+        # its first replayed round exactly as the uninterrupted one did.
+        raw["exchange"] = {"pipeline": True}
     elif mode != "dense":
         raise ValueError(f"unknown durability mode {mode!r}")
     return Config.model_validate(raw)

@@ -127,7 +127,7 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 # runners composed by run_check_detailed.
 _CHECK_ENTRY_POINTS = frozenset(
     {"check_ir", "check_coverage", "check_flow", "check_durability",
-     "check_adaptive", "check_staleness"}
+     "check_adaptive", "check_staleness", "check_pipeline"}
 )
 
 
@@ -1666,6 +1666,13 @@ def check_coverage() -> List[Finding]:
     findings.extend(
         _unwired_family_findings(
             staleness_mod, staleness_mod.STALE_CHECK_FAMILIES
+        )
+    )
+    from murmura_tpu.analysis import pipeline as pipeline_mod
+
+    findings.extend(
+        _unwired_family_findings(
+            pipeline_mod, pipeline_mod.PIPELINE_CHECK_FAMILIES
         )
     )
     return findings

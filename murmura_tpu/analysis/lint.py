@@ -124,6 +124,12 @@ RULES: Dict[str, str] = {
     "MUR1101": "stale-recompile",
     "MUR1102": "stale-collective-inventory",
     "MUR1103": "stale-influence-replay-hole",
+    # 12xx = pipelined-rounds contracts (analysis/pipeline.py;
+    # docs/PERFORMANCE.md "Pipelined rounds")
+    "MUR1200": "pipeline-state-registry",
+    "MUR1201": "pipeline-recompile",
+    "MUR1202": "pipeline-collective-inventory",
+    "MUR1203": "pipeline-delayed-influence",
 }
 
 

@@ -650,6 +650,15 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "PATHS are given.",
 )
 @click.option(
+    "--pipeline/--no-pipeline", "pipeline", default=None,
+    help="Run the pipelined-rounds contracts (MUR1200-1203: pipeline-"
+         "state registry bijection, zero recompiles across buffer "
+         "swaps, collective-inventory parity with the serialized "
+         "program, delayed-step influence/lagging-verdict taint runs).  "
+         "Compiles and runs tiny programs (~1 min on CPU).  Default: on "
+         "for the package check, off when explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary records) as JSON "
          "lines for editor/CI annotation instead of the greppable text "
@@ -661,7 +670,7 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "review the diff as perf history.",
 )
 def check(paths, contracts, ir, flow, durability, adaptive, staleness,
-          as_json, update_budgets):
+          pipeline, as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -697,6 +706,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     findings, records = run_check_detailed(
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
         durability=durability, adaptive=adaptive, staleness=staleness,
+        pipeline=pipeline,
     )
     if as_json:
         out = format_findings_json(findings, records)

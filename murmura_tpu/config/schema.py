@@ -273,10 +273,11 @@ class FaultsConfig(_Strict):
 
 
 class ExchangeConfig(_Strict):
-    """Bounded-staleness gossip exchange (murmura_tpu extension; ISSUE 13
-    — docs/ROBUSTNESS.md "Bounded staleness"; PAPERS.md: asynchronous
-    quantized decentralized SGD arXiv:1910.12308, delayed averaging
-    arXiv:2002.01119).
+    """Exchange-layer semantics: bounded-staleness gossip (ISSUE 13 —
+    docs/ROBUSTNESS.md "Bounded staleness") and pipelined rounds
+    (ISSUE 14 — docs/PERFORMANCE.md "Pipelined rounds"); PAPERS.md:
+    asynchronous quantized decentralized SGD arXiv:1910.12308, delayed
+    averaging arXiv:2002.01119.
 
     With ``max_staleness`` >= 1 the round program carries a per-sender
     payload cache + integer age stamp in ``agg_state`` (reserved
@@ -289,9 +290,9 @@ class ExchangeConfig(_Strict):
     contract), and ages past the bound degrade to today's drop-the-edge
     behavior.
 
-    Default (``max_staleness: 0``) => byte-identical behavior to a config
-    without this block: the compiled round program, histories, and random
-    streams are untouched.
+    Default (``max_staleness: 0``, ``pipeline: false``) => byte-identical
+    behavior to a config without this block: the compiled round program,
+    histories, and random streams are untouched.
     """
 
     max_staleness: int = Field(
@@ -309,6 +310,20 @@ class ExchangeConfig(_Strict):
             "adjacency weight (weight = discount ** age).  Mean-family "
             "rules honor the fraction; selection rules (krum/median/"
             "trimmed) treat any positive weight as a full candidate"
+        ),
+    )
+    pipeline: bool = Field(
+        default=False,
+        description=(
+            "Pipelined rounds (ISSUE 14; docs/PERFORMANCE.md 'Pipelined "
+            "rounds'): overlap round r's local training with round "
+            "r-1's exchange + aggregation through a double-buffered "
+            "pipeline stage riding agg_state (one-round-delayed "
+            "averaging, arXiv:2002.01119).  Round r's params then "
+            "contain round r's local step plus round r-1's aggregation "
+            "displacement.  Composes with compression, faults, "
+            "staleness, sparse topologies and gang sweeps; default off "
+            "=> byte-identical programs and histories"
         ),
     )
 
@@ -1008,10 +1023,12 @@ class Config(_Strict):
     exchange: ExchangeConfig = Field(
         default_factory=ExchangeConfig,
         description=(
-            "Bounded-staleness gossip exchange (stale-tolerant cache + "
-            "age-bounded re-delivery under faults; docs/ROBUSTNESS.md); "
-            "default (max_staleness 0) => byte-identical to no exchange "
-            "block"
+            "Exchange-layer semantics: bounded-staleness gossip "
+            "(stale-tolerant cache + age-bounded re-delivery under "
+            "faults; docs/ROBUSTNESS.md) and pipelined rounds (delayed "
+            "aggregation overlapping local training; "
+            "docs/PERFORMANCE.md); default (max_staleness 0, pipeline "
+            "false) => byte-identical to no exchange block"
         ),
     )
     sweep: Optional[SweepConfig] = Field(
@@ -1315,6 +1332,42 @@ class Config(_Strict):
                 "cohort swaps reassign node slots, so a cached row would "
                 "be served into the wrong user's stream — the "
                 "compression carried-state rationale)"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _pipeline_is_wirable(self):
+        if not self.exchange.pipeline:
+            return self
+        if self.backend == "distributed":
+            raise ValueError(
+                "exchange.pipeline runs the delayed aggregation inside "
+                "the jitted round program (the buffer rides the scan "
+                "carry); backend: distributed exchanges full states over "
+                "ZMQ per round — use backend: simulation or tpu"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "exchange.pipeline does not compose with dmtt (claim "
+                "verification gates each round's exchange between "
+                "production and aggregation; delaying the aggregation "
+                "would verify claims against a different round's graph)"
+            )
+        if self.attack.adaptive.enabled:
+            raise ValueError(
+                "exchange.pipeline does not compose with "
+                "attack.adaptive: the acceptance feedback would observe "
+                "round r-1's aggregation after round r's production "
+                "already ran, changing the closed loop's timing "
+                "semantics — run adaptive experiments serialized"
+            )
+        if self.population is not None and self.population.enabled:
+            raise ValueError(
+                "exchange.pipeline does not compose with population "
+                "(the pipeline buffer is per-slot [N, P] carried state; "
+                "cohort swaps reassign node slots, so a buffered row "
+                "would be aggregated into the wrong user's stream — the "
+                "compression/staleness carried-state rationale)"
             )
         return self
 

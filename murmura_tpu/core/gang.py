@@ -872,6 +872,11 @@ class GangNetwork:
     # ------------------------------------------------------------------
 
     def _emit_phase_times(self, round_idx, mode, wall_s, **extra) -> None:
+        if self.program.pipelined:
+            # The pipelined critical-path marker, mirrored from
+            # Network._phase_overlap so gang members' reports render the
+            # same critical-path decomposition as single runs.
+            extra.setdefault("overlap", "pipelined")
         for t in self.telemetry:
             if t is not None:
                 t.phase_times(

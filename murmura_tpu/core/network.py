@@ -589,6 +589,24 @@ class Network:
                 trace_dir=t.profile_dir or str(t.run_dir / "trace"),
             )
 
+    def _phase_overlap(self) -> Dict[str, str]:
+        """Extra phase_times fields describing in-dispatch concurrency.
+
+        A pipelined program (exchange.pipeline) runs train and the
+        delayed exchange+aggregate concurrently inside every dispatch:
+        the recorded wall time is the round's CRITICAL PATH, and the
+        per-phase named_scope brackets overlap in profiler-trace time —
+        summing them would double-count the hidden exchange.  The
+        ``overlap`` marker lets ``murmura report`` render a
+        critical-path decomposition instead (telemetry/report.py);
+        serialized programs emit no marker, keeping their phase_times
+        records byte-identical to previous releases (pinned by
+        tests/test_pipeline.py).
+        """
+        if self.program.pipelined:
+            return {"overlap": "pipelined"}
+        return {}
+
     def _sanitizer_scope(self):
         """The shared :func:`sanitizer_scope` over this orchestrator."""
         return sanitizer_scope(self)
@@ -674,6 +692,7 @@ class Network:
                 for i in range(k):
                     self.telemetry.phase_times(
                         round0 + i, "fused", elapsed / k, chunk=k,
+                        **self._phase_overlap(),
                     )
                 self.telemetry.memory_event(self.current_round - 1)
                 self._profile_window_stop(self.current_round)
@@ -763,6 +782,7 @@ class Network:
                     round_idx, "per_round", wall,
                     evaluated=bool(self.current_round % eval_every == 0),
                     deferred=bool(defer_metrics),
+                    **self._phase_overlap(),
                 )
                 self.telemetry.memory_event(round_idx)
                 self._profile_window_stop(self.current_round)

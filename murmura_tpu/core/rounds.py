@@ -45,7 +45,16 @@ from murmura_tpu.dmtt.protocol import (
     init_dmtt_state,
 )
 from murmura_tpu.models.core import Model
+from murmura_tpu.core.pipeline import (
+    ADJ_KEY as PIPE_ADJ_KEY,
+    BCAST_KEY as PIPE_BCAST_KEY,
+    OWN_KEY as PIPE_OWN_KEY,
+    VALID_KEY as PIPE_VALID_KEY,
+    init_pipeline_state,
+    pipeline_state_keys,
+)
 from murmura_tpu.core.stale import (
+    CACHE_KEY as STALE_CACHE_KEY,
     STALE_STATE_KEYS,
     StalenessSpec,
     init_stale_state,
@@ -129,6 +138,21 @@ class RoundProgram:
     # age stays within ``max_staleness``.  None (default) => the traced
     # program is byte-identical to pre-staleness builds.
     staleness: Optional[StalenessSpec] = None
+    # Pipelined rounds (core/pipeline.py; docs/PERFORMANCE.md "Pipelined
+    # rounds"): round r's local training overlaps round r-1's
+    # exchange + aggregation through a double-buffered pipeline stage
+    # riding ``agg_state`` under PIPELINE_STATE_KEYS — one-round-delayed
+    # averaging (arXiv:2002.01119).  False (default) => the traced
+    # program is byte-identical to pre-pipeline builds.
+    pipelined: bool = False
+    # The training-only stage of the round — the delayed-averaging
+    # reference hook (core/pipeline.run_delayed_reference): same
+    # signature as ``train_step`` but returns ``(own_flat, train_ok)``,
+    # the post-scrub trained [N, P] flat params and the [N] quarantine
+    # verdict (1.0 = clean).  A pure sub-computation of ``train_step``
+    # (jit DCEs the attack/codec/exchange stages), present on every
+    # build.
+    train_flat: Optional[Callable] = None
 
     @property
     def sparse(self) -> bool:
@@ -167,6 +191,7 @@ def build_round_program(
     sparse_offsets: Optional[Tuple[int, ...]] = None,
     compression: Optional[CompressionSpec] = None,
     staleness: Optional[StalenessSpec] = None,
+    pipeline: bool = False,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -280,6 +305,25 @@ def build_round_program(
                 "does not model)"
             )
         audit_taps = True
+
+    # Pipelined rounds (core/pipeline.py): round r's delayed aggregation
+    # of the buffered round-(r-1) exchange overlaps round r's training.
+    if pipeline:
+        if dmtt is not None:
+            raise ValueError(
+                "pipelined rounds do not compose with DMTT (the claim "
+                "exchange + trust gate runs between production and "
+                "aggregation every round; delaying the aggregation would "
+                "verify claims against a different round's graph)"
+            )
+        if adaptive:
+            raise ValueError(
+                "pipelined rounds do not compose with adaptive attacks: "
+                "the acceptance feedback would observe round r-1's "
+                "aggregation while the attack state already advanced at "
+                "round r's production, changing the closed loop's timing "
+                "semantics — run adaptive experiments serialized"
+            )
 
     # Built after the adaptive block so the fold's audit taps follow the
     # final audit_taps value (adaptive attacks force tapping on).
@@ -508,7 +552,32 @@ def build_round_program(
     else:
         _inject_rows = None
 
-    def _round_body(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
+    # Whether rules with quantized exchange kernels receive the Int8Blocks
+    # payload itself.  Both the stale fold and the pipeline buffer carry
+    # ONE decoded [N, P] row per sender (a fresh/stale row mix — or a
+    # buffered one — cannot be expressed inside one Int8Blocks payload),
+    # so either layer forces the receiver-side dequantized path: wire
+    # bytes are unchanged (the codec still runs, EF still telescopes) but
+    # the MUR700 s8-collective property is a stale-off AND pipeline-off
+    # contract (docs/PERFORMANCE.md).
+    quantized_payload = (
+        agg.quantized_exchange and stale_fold is None and not pipeline
+    )
+
+    def _produce_exchange(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
+        """Steps 1-2d of the round: local training, the broadcast with
+        attack + sentinel scrubs, the codec, and the stale fold — the
+        *production* of one round's exchange, shared verbatim by the
+        serialized and pipelined bodies (and, via ``train_flat``, the
+        delayed-averaging reference) so the three cannot drift.
+
+        Returns a dict with the trained ``params`` pytree, the
+        post-scrub ``own_flat``/``bcast``/``adj`` triple exactly as the
+        serialized aggregation would consume it, the quarantine
+        bookkeeping (``pre_flat``/``finite``), the updated ``agg_state``
+        (codec/stale keys), the per-stage stats dicts, and the adaptive
+        attack's consumed state.
+        """
         train_key, attack_key = jax.random.split(key)
         honest = 1.0 - compromised
 
@@ -581,6 +650,7 @@ def build_round_program(
         else:
             finite = None
         bcast_finite = None
+        attack_state = None
         if attack_apply is not None:
             # Cast back: float32 attack noise must not promote the exchanged
             # [N, P] tensor when params are stored bfloat16 (tpu.param_dtype).
@@ -649,17 +719,13 @@ def build_round_program(
         compress_stats = {}
         if compression is not None:
             with jax.named_scope("murmura.compress"):
-                # With staleness armed the rule consumes the receiver-side
-                # dequantized tensor even for quantized_exchange rules: the
-                # cache stores (and substitutes) one decoded [N, P] row per
-                # sender, and a fresh/stale row mix cannot be expressed
-                # inside one Int8Blocks payload.  Wire bytes are unchanged
-                # — the codec still runs — but the MUR700 s8-collective
-                # property is a stale-off contract (docs/PERFORMANCE.md).
+                # With staleness (or the pipeline buffer) armed the rule
+                # consumes the receiver-side dequantized tensor even for
+                # quantized_exchange rules — see the quantized_payload
+                # comment above.
                 bcast, _decoded, comp_updates, compress_stats = (
                     compress_exchange(
-                        compression, bcast, agg_state,
-                        agg.quantized_exchange and stale_fold is None,
+                        compression, bcast, agg_state, quantized_payload,
                     )
                 )
             agg_state = {**agg_state, **comp_updates}
@@ -697,7 +763,22 @@ def build_round_program(
                 )
             agg_state = {**agg_state, **stale_updates}
 
-        step_ctx = AggContext(
+        return {
+            "params": params,
+            "own_flat": own_flat,
+            "bcast": bcast,
+            "adj": adj,
+            "pre_flat": pre_flat if alive is not None else None,
+            "finite": finite,
+            "agg_state": agg_state,
+            "attack_state": attack_state,
+            "fault_stats": fault_stats,
+            "compress_stats": compress_stats,
+            "stale_stats": stale_stats,
+        }
+
+    def _step_ctx(d) -> AggContext:  # murmura: traced
+        return AggContext(
             apply_fn=ctx.apply_fn,
             unravel=ctx.unravel,
             probe_x=d["probe_x"],
@@ -709,6 +790,24 @@ def build_round_program(
             node_axis_sharded=ctx.node_axis_sharded,
             audit=ctx.audit,
         )
+
+    def _round_body(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
+        prod = _produce_exchange(
+            params, agg_state, key, adj, compromised, alive, round_idx, d
+        )
+        params = prod["params"]
+        own_flat = prod["own_flat"]
+        bcast = prod["bcast"]
+        adj = prod["adj"]
+        pre_flat = prod["pre_flat"]
+        finite = prod["finite"]
+        agg_state = prod["agg_state"]
+        attack_state = prod["attack_state"]
+        fault_stats = prod["fault_stats"]
+        compress_stats = prod["compress_stats"]
+        stale_stats = prod["stale_stats"]
+
+        step_ctx = _step_ctx(d)
 
         # 2b. DMTT: claim exchange + trust update gate the exchange mask
         # (murmura/dmtt/node_process.py:187-241).  The N x N probe cross-eval
@@ -797,16 +896,162 @@ def build_round_program(
         metrics.update({f"agg_{k}": v for k, v in attack_round_stats.items()})
         return params, agg_state, metrics
 
+    # Reserved agg_state keys a pipelined aggregation must never hand to
+    # the rule (the serialized body's ``reserved`` plus the pipeline's
+    # own buffer keys; dmtt/adaptive were rejected above).
+    pipe_keys = pipeline_state_keys(stale=staleness is not None)
+    pipe_reserved = (
+        set(COMPRESS_STATE_KEYS) | set(pipe_keys)
+    )
+    if stale_fold is not None:
+        pipe_reserved |= set(STALE_STATE_KEYS)
+
+    def _round_body_pipelined(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
+        """One pipelined round (core/pipeline.py; docs/PERFORMANCE.md
+        "Pipelined rounds"): stage A aggregates the BUFFERED round-(r-1)
+        exchange, stage B produces round r's exchange (training included)
+        with no data dependence on stage A, and stage C applies the
+        delayed displacement and swaps the buffer.  Stage A is issued
+        first so its collectives on the buffered tensor precede the
+        training scan in program order — XLA's async dispatch can overlap
+        them with the training matmuls (the tentpole's point)."""
+        # ---- stage A: delayed aggregation of the buffered exchange ----
+        valid = agg_state[PIPE_VALID_KEY]
+        buf_own = agg_state[PIPE_OWN_KEY]
+        if stale_fold is not None:
+            # Buffer reuse (core/stale.py): after round r-1 the stale
+            # fold's payload cache holds exactly the post-fold broadcast
+            # the delayed aggregation must consume — read it instead of
+            # carrying a duplicate [N, P] buffer.  Read BEFORE stage B
+            # advances the cache to round r's payload.
+            buf_bcast = agg_state[STALE_CACHE_KEY].astype(buf_own.dtype)
+        else:
+            buf_bcast = agg_state[PIPE_BCAST_KEY]
+        buf_adj = agg_state[PIPE_ADJ_KEY]
+        if sparse:
+            # Stored node-leading [N, k] for mesh placement
+            # (init_pipeline_state); the rules consume [k, N].
+            buf_adj = buf_adj.T
+        rule_state = {
+            k: v for k, v in agg_state.items() if k not in pipe_reserved
+        }
+        step_ctx = _step_ctx(d)
+        with jax.named_scope("murmura.aggregate"):
+            # The buffered exchange belongs to round r-1; rules with
+            # round schedules (BALANCE tightening, trust annealing) see
+            # the round the payload was produced in.  Round 0's buffer
+            # is the invalid placeholder — clamped index, output and
+            # rule-state update all where-discarded below.
+            agg_ridx = jnp.maximum(round_idx - 1.0, 0.0)
+            agg_out, rule_state_new, agg_stats = agg.aggregate(
+                buf_own, buf_bcast, buf_adj, agg_ridx, rule_state, step_ctx
+            )
+        if alive is not None:
+            # The serialized zero-alive-neighbor guard, applied at the
+            # buffered graph (a sender-isolated receiver at round r-1
+            # degrades to self-model there, exactly as the serialized
+            # round r-1 would have).
+            deg_b = _in_degree(buf_adj)
+            agg_out = jnp.where((deg_b > 0)[:, None], agg_out, buf_own)
+        # The displacement the serialized round r-1 would have applied.
+        # where, not multiply: a hypothetical non-finite value in the
+        # warm-up placeholder aggregation must be DISCARDED, not scaled
+        # (0 * inf == nan — the fault sentinels' static-scrub contract).
+        disp = jnp.where(
+            valid > 0, agg_out - buf_own, jnp.zeros_like(buf_own)
+        )
+        # Warm-up exactness for carried rule state too: the round-0
+        # placeholder aggregation must not write trust/threshold state.
+        rule_state = {
+            k: (
+                jnp.where(valid > 0, v, rule_state[k])
+                if k in rule_state else v
+            )
+            for k, v in rule_state_new.items()
+        }
+
+        # ---- stage B: production of round r's exchange ----------------
+        prod = _produce_exchange(
+            params, agg_state, key, adj, compromised, alive, round_idx, d
+        )
+        own_flat = prod["own_flat"]
+        pre_flat = prod["pre_flat"]
+        finite = prod["finite"]
+        agg_state = prod["agg_state"]
+        fault_stats = prod["fault_stats"]
+
+        # ---- stage C: combine + buffer swap ---------------------------
+        with jax.named_scope("murmura.pipeline"):
+            new_flat = own_flat + disp.astype(own_flat.dtype)
+            if alive is not None:
+                # Dead nodes freeze and quarantined nodes roll back —
+                # own_flat already equals pre_flat on those rows, so the
+                # keep-mask reduces to discarding the delayed
+                # displacement (mirrored bit-for-bit by
+                # core/pipeline.run_delayed_reference).
+                keep = alive > 0
+                if finite is not None:
+                    keep = keep & finite
+                new_flat = jnp.where(keep[:, None], new_flat, pre_flat)
+                fault_stats["alive"] = alive.sum()
+                if audit_taps:
+                    fault_stats["tap_alive"] = alive
+            params = jax.vmap(unravel)(new_flat)
+        buffer_updates = {
+            PIPE_OWN_KEY: own_flat,
+            PIPE_ADJ_KEY: prod["adj"].T if sparse else prod["adj"],
+            PIPE_VALID_KEY: jnp.ones_like(valid),
+        }
+        if stale_fold is None:
+            buffer_updates[PIPE_BCAST_KEY] = prod["bcast"]
+        agg_state = {**agg_state, **rule_state, **buffer_updates}
+
+        metrics = {f"agg_{k}": v for k, v in agg_stats.items()}
+        metrics.update({f"agg_{k}": v for k, v in fault_stats.items()})
+        metrics.update(
+            {f"agg_{k}": v for k, v in prod["compress_stats"].items()}
+        )
+        metrics.update(
+            {f"agg_{k}": v for k, v in prod["stale_stats"].items()}
+        )
+        # 0.0 on the warm-up round: this round's agg_* stats describe
+        # the invalid placeholder aggregation, not a real exchange.
+        metrics["agg_pipe_valid"] = valid
+        return params, agg_state, metrics
+
+    body = _round_body_pipelined if pipeline else _round_body
     if faults is None:
         def train_round(params, agg_state, key, adj, compromised, round_idx, d):  # murmura: traced
-            return _round_body(
+            return body(
                 params, agg_state, key, adj, compromised, None, round_idx, d
             )
+
+        def train_flat(params, agg_state, key, adj, compromised, round_idx, d):  # murmura: traced
+            prod = _produce_exchange(
+                params, agg_state, key, adj, compromised, None, round_idx, d
+            )
+            ok = (
+                prod["finite"].astype(jnp.float32)
+                if prod["finite"] is not None
+                else jnp.ones_like(compromised)
+            )
+            return prod["own_flat"], ok
     else:
         def train_round(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
-            return _round_body(
+            return body(
                 params, agg_state, key, adj, compromised, alive, round_idx, d
             )
+
+        def train_flat(params, agg_state, key, adj, compromised, alive, round_idx, d):  # murmura: traced
+            prod = _produce_exchange(
+                params, agg_state, key, adj, compromised, alive, round_idx, d
+            )
+            ok = (
+                prod["finite"].astype(jnp.float32)
+                if prod["finite"] is not None
+                else jnp.ones_like(compromised)
+            )
+            return prod["own_flat"], ok
 
     def eval_step(params, d):  # murmura: traced
         # evaluation (network.py:141-199) — held-out arrays when the data
@@ -871,6 +1116,28 @@ def build_round_program(
                 for k, v in attack.init_attack_state(n).items()
             }
         )
+    if pipeline:
+        # The double-buffered pipeline stage rides agg_state under the
+        # reserved PIPELINE_STATE_KEYS slice — same shapes/dtypes every
+        # round, so the scan carry, gang vmap, donation aliases and
+        # durability snapshots all hold without special cases (the
+        # COMPRESS/STALE_STATE_KEYS story).  With staleness armed the
+        # broadcast buffer is the stale cache (buffer reuse —
+        # core/pipeline.pipeline_state_keys).
+        clash = set(pipe_keys) & set(init_agg_state)
+        if clash:
+            raise ValueError(
+                f"aggregator '{agg.name}' carries state keys "
+                f"{sorted(clash)} reserved for the pipelined exchange"
+            )
+        leaf = jax.tree_util.tree_leaves(init_params)[0]
+        init_agg_state.update(
+            init_pipeline_state(
+                n, model_dim, leaf.dtype,
+                sparse_offsets=sparse_offsets,
+                stale=staleness is not None,
+            )
+        )
 
     return RoundProgram(
         train_step=train_round,
@@ -887,6 +1154,8 @@ def build_round_program(
         compression=compression,
         adaptive_attack=adaptive,
         staleness=staleness,
+        pipelined=pipeline,
+        train_flat=train_flat,
     )
 
 

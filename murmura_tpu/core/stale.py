@@ -54,6 +54,15 @@ evidential trust) honor the fractional re-added weight directly;
 selection rules (krum, median, trimmed mean) treat any positive weight as
 a full candidate — a candidate cannot be 0.8-selected — so for them
 ``staleness_discount`` only controls nothing vs something.
+
+Pipeline buffer reuse (ISSUE 14; core/pipeline.py): the cache-advance
+invariant below — after the fold, ``stale_cache`` holds EXACTLY the
+post-fold broadcast receivers aggregated this round — is what lets
+pipelined rounds (``exchange.pipeline``) use this cache as their
+broadcast buffer: round r+1's delayed aggregation reads the cache
+before round r+1's fold advances it, getting round r's served payload
+byte-for-byte, so a staleness-composed pipelined build carries no
+duplicate ``pipe_bcast`` tensor (core/pipeline.pipeline_state_keys).
 """
 
 from dataclasses import dataclass, field
@@ -259,7 +268,9 @@ def make_stale_fold(
         # One payload version per sender: fresh rows pass through, stale
         # rows substitute the cached copy.  The cache then advances to
         # exactly what receivers could aggregate this round, so the
-        # served representation and the stored one never diverge.
+        # served representation and the stored one never diverge — the
+        # invariant the pipelined rounds' buffer reuse relies on (module
+        # docstring; core/pipeline.py reads this cache as pipe_bcast).
         fresh = deliver[:, None] > 0
         bcast_eff = jnp.where(fresh, bcast, cache.astype(bcast.dtype))
         updates = {

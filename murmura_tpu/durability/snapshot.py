@@ -78,6 +78,7 @@ RESERVED_AGG_STATE_KEY_GROUPS: Dict[str, str] = {
     "ATTACK_STATE_KEYS": "murmura_tpu.attacks.adaptive",
     "COMPRESS_STATE_KEYS": "murmura_tpu.ops.compress",
     "DMTT_STATE_KEYS": "murmura_tpu.core.rounds",
+    "PIPELINE_STATE_KEYS": "murmura_tpu.core.pipeline",
     "STALE_STATE_KEYS": "murmura_tpu.core.stale",
 }
 

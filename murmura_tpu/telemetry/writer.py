@@ -153,7 +153,13 @@ class TelemetryWriter:
     def phase_times(self, round_idx: int, mode: str, wall_s: float, **extra) -> None:
         """One round's time record.  ``mode`` carries the dispatch
         semantics (schema.py): per_round = wall round time, fused =
-        elapsed/k amortized over the chunk."""
+        elapsed/k amortized over the chunk.  Pipelined programs
+        (exchange.pipeline) additionally pass ``overlap="pipelined"``:
+        the round's train and (delayed) exchange+aggregate phases run
+        concurrently inside one dispatch, so ``wall_s`` is the round's
+        CRITICAL PATH — per-phase profiler brackets (murmura.train /
+        murmura.aggregate) overlap in trace time and must not be summed
+        (`murmura report` renders a critical_path section instead)."""
         if not self.record_phase_times:
             return
         self.emit(

@@ -702,6 +702,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             sparse_offsets=tuple(topology.offsets) if sparse else None,
             compression=build_compression_spec(config),
             staleness=build_staleness_spec(config, topology),
+            pipeline=config.exchange.pipeline,
         ))
 
     writers = None
@@ -921,6 +922,7 @@ def build_network_from_config(
         sparse_offsets=tuple(topology.offsets) if sparse else None,
         compression=build_compression_spec(config),
         staleness=build_staleness_spec(config, topology),
+        pipeline=config.exchange.pipeline,
     )
 
     if config.backend == "tpu" and mesh is None:

@@ -64,6 +64,139 @@ SPARSE_POINTS = [
 ]
 
 
+# --sharded variant (ISSUE 15): param-axis sharding cells — a big
+# per-node MLP on the ("seed", "nodes", "param") CPU/TPU mesh
+# (tpu.param_shards; docs/PERFORMANCE.md "Param-axis sharding").  The
+# flagship cell is the acceptance point: a >= 50M-param-per-node model at
+# N=16 on ONE host, every [N, P] round tensor resident at N x P/shards
+# per device.  Each cell records the analytic per-device resident params
+# (the number the axis exists to shrink) next to the measured peak RSS.
+SHARDED_POINTS = [
+    # ~0.9M params: the layout-sweep cell (fast everywhere).
+    {"nodes": 16, "shards": 4, "algo": "krum",
+     "hidden": [512, 512], "input_dim": 256},
+    # >= 50M params per node at N=16: the acceptance cell.  1000 x 7200
+    # + 7200 x 6200 + 6200 x 62 (+ biases) = 51.9M params; at shards=8
+    # the [N, P] round tensors are resident at 16 x 6.5M floats per
+    # device instead of 16 x 51.9M.
+    {"nodes": 16, "shards": 8, "algo": "krum",
+     "hidden": [7200, 6200], "input_dim": 1000},
+]
+
+
+def run_sharded_point(
+    nodes: int, shards: int, algo: str, hidden, input_dim: int,
+    on_cpu: bool, require_tpu: bool = False,
+) -> None:
+    """Child-process body: one param-sharding point, one JSON line."""
+    import jax
+
+    if on_cpu:
+        # The sharded CPU mesh needs virtual devices BEFORE backend init.
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        )
+        jax.config.update("jax_platforms", "cpu")
+    elif require_tpu or os.environ.get("MURMURA_REQUIRE_TPU") == "1":
+        # Same guard as run_point: a TPU that detached between the
+        # parent's probe and this child must abort the point loudly, not
+        # land a silent CPU cell inside a TPU-stamped artifact (the
+        # r03-r05 mislabeling class).
+        from murmura_tpu.durability.dispatch import (
+            BackendRequirementError,
+            require_tpu as _require,
+        )
+
+        try:
+            _require("bench_scaling --sharded-point (--require-tpu)")
+        except BackendRequirementError as e:
+            print(f"bench_scaling --sharded-point: {e}", file=sys.stderr,
+                  flush=True)
+            raise SystemExit(2)
+    point_platform = jax.default_backend()
+
+    from murmura_tpu.config import Config
+    from murmura_tpu.parallel.mesh import (
+        mesh_node_axis,
+        mesh_param_shards,
+    )
+    from murmura_tpu.utils.factories import build_network_from_config
+
+    classes = 62
+    cfg = Config.model_validate(
+        {
+            "experiment": {"name": f"sharded-{algo}-{nodes}x{shards}",
+                           "seed": 7, "rounds": 3},
+            "topology": {"type": "k-regular", "num_nodes": nodes, "k": 4},
+            "aggregation": {"algorithm": algo,
+                            "params": ({"num_compromised": 1}
+                                       if algo == "krum" else {})},
+            "training": {"local_epochs": 1, "batch_size": 4, "lr": 0.05},
+            "data": {
+                "adapter": "synthetic",
+                "params": {"num_samples": 8 * nodes,
+                           "input_shape": [input_dim],
+                           "num_classes": classes},
+            },
+            "model": {"factory": "mlp",
+                      "params": {"input_dim": input_dim,
+                                 "hidden_dims": list(hidden),
+                                 "num_classes": classes}},
+            "backend": "tpu",
+            "tpu": {
+                "param_shards": shards,
+                "compute_dtype": "float32",
+                "param_dtype": "float32",
+            },
+        }
+    )
+    network = build_network_from_config(cfg)
+    mesh = network.mesh
+    nodes_ax = mesh_node_axis(mesh)
+    param_ax = mesh_param_shards(mesh)
+
+    timed = 2
+    t0 = time.perf_counter()
+    network.train(rounds=1, eval_every=10)
+    first_round_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    network.train(rounds=timed, eval_every=10)
+    rounds_per_sec = timed / (time.perf_counter() - t0)
+
+    flat = int(network.program.flat_dim)
+    mem = {"peak_host_rss_bytes": resource.getrusage(
+        resource.RUSAGE_SELF
+    ).ru_maxrss * 1024}
+    stats = jax.local_devices()[0].memory_stats() or {}
+    if "peak_bytes_in_use" in stats:
+        mem["peak_device_bytes"] = int(stats["peak_bytes_in_use"])
+    print(json.dumps({
+        "nodes": nodes,
+        "algo": algo,
+        "exchange": "sharded",
+        "platform": point_platform,
+        "param_shards_requested": shards,
+        "mesh": {"seed": 1, "nodes": nodes_ax, "param": param_ax},
+        "model_dim": int(network.program.model_dim),
+        "flat_dim": flat,
+        # The memory model (docs/PERFORMANCE.md): per-device resident
+        # floats for ONE [N, P]-class round tensor, sharded vs not — the
+        # max-resident-params-per-device cell of the scaling record.
+        "flat_params_per_device": (nodes // nodes_ax) * (flat // param_ax),
+        "flat_params_per_device_unsharded": nodes * flat,
+        # Training keeps each node's full model resident (the pytree is
+        # node-sharded, param-replicated).
+        "train_params_per_device": (nodes // nodes_ax) * int(
+            network.program.model_dim
+        ),
+        "rounds_per_sec": round(rounds_per_sec, 4),
+        "first_round_s": round(first_round_s, 1),
+        "timed_rounds_per_block": timed,
+        **mem,
+    }))
+
+
 def run_point(
     nodes: int, algo: str, exchange: str, on_cpu: bool, variant: str = "",
     require_tpu: bool = False,
@@ -323,6 +456,17 @@ def main():
                     help="run the exponential-graph sparse-exchange cells "
                          "(N in {256, 1024, 4096}) instead of the dense/"
                          "circulant grid; writes bench_scaling_sparse.json")
+    ap.add_argument("--sharded", action="store_true",
+                    help="run the param-axis sharding cells (ISSUE 15: a "
+                         ">= 50M-param-per-node model at N=16 on one "
+                         "host's mesh, tpu.param_shards) instead of the "
+                         "dense/circulant grid; writes "
+                         "bench_scaling_sharded.json")
+    ap.add_argument("--sharded-point", nargs=5,
+                    metavar=("NODES", "SHARDS", "ALGO", "HIDDEN", "INPUT"),
+                    default=None,
+                    help="internal: run one sharded point in-process "
+                         "(HIDDEN is comma-separated layer widths)")
     ap.add_argument("--timeout", type=float, default=1800.0)
     ap.add_argument("--out", default=None)
     ap.add_argument("--force", action="store_true",
@@ -333,10 +477,20 @@ def main():
     args = ap.parse_args()
     if args.out is None:
         args.out = str(Path(__file__).parent / (
+            "bench_scaling_sharded.json" if args.sharded else
             "bench_scaling_sparse.json" if args.sparse else
             "bench_scaling.json"
         ))
 
+    if args.sharded_point:
+        run_sharded_point(
+            int(args.sharded_point[0]), int(args.sharded_point[1]),
+            args.sharded_point[2],
+            [int(h) for h in args.sharded_point[3].split(",")],
+            int(args.sharded_point[4]), args.cpu,
+            require_tpu=args.require_tpu,
+        )
+        return
     if args.point:
         run_point(int(args.point[0]), args.point[1], args.point[2], args.cpu,
                   variant=args.variant, require_tpu=args.require_tpu)
@@ -391,17 +545,29 @@ def main():
         Path(args.out).write_text(json.dumps(blob, indent=2) + "\n")
         return blob
 
-    for p in (SPARSE_POINTS if args.sparse else POINTS):
-        cmd = [sys.executable, __file__, "--point", str(p["nodes"]),
-               p["algo"], p["exchange"]]
-        if p.get("variant"):
-            cmd += ["--variant", p["variant"]]
+    points = (
+        SHARDED_POINTS if args.sharded
+        else SPARSE_POINTS if args.sparse else POINTS
+    )
+    for p in points:
+        if args.sharded:
+            cmd = [sys.executable, __file__, "--sharded-point",
+                   str(p["nodes"]), str(p["shards"]), p["algo"],
+                   ",".join(str(h) for h in p["hidden"]),
+                   str(p["input_dim"])]
+            label = (f"[{p['nodes']:>3} nodes x {p['shards']} shards "
+                     f"{p['algo']}/sharded]")
+        else:
+            cmd = [sys.executable, __file__, "--point", str(p["nodes"]),
+                   p["algo"], p["exchange"]]
+            if p.get("variant"):
+                cmd += ["--variant", p["variant"]]
+            label = f"[{p['nodes']:>3} nodes {p['algo']}/{p['exchange']}]"
         if on_cpu:
             cmd.append("--cpu")
         if args.require_tpu:
             cmd.append("--require-tpu")
-        print(f"[{p['nodes']:>3} nodes {p['algo']}/{p['exchange']}] ...",
-              file=sys.stderr, flush=True)
+        print(f"{label} ...", file=sys.stderr, flush=True)
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=args.timeout)

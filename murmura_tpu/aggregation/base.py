@@ -311,10 +311,33 @@ def _p_chunk_len(n: int, p: int, itemsize: int, floor: int = 4) -> int:
     the subtract/FMA chain — there is no standalone f32 copy per roll), so
     sizing the exchange chunk by the ≥4-byte float assumption would cut
     the chunk 4x and quadruple the ppermute count for no memory benefit.
+
+    Param-axis sharding (parallel/mesh.py ``param_axis_scope``): under an
+    active param-sharded trace scope the budget is SHARD-LOCAL — a
+    [N, chunk] rolled copy is resident at chunk/shards columns per
+    device, so the admissible chunk scales UP by the shard count.  That
+    keeps programs the sharded budget can hold entirely UNCHUNKED, which
+    matters more than it reads: a chunk loop's traced-start
+    dynamic-slices on the column axis cannot be proven shard-aligned by
+    GSPMD, so any chunking under a sharded P degrades to column
+    all-gathers (MUR1300's subject).  Programs still too large for the
+    scaled budget keep the loop with chunks aligned to whole shard-local
+    widths — documented degradation; add shards (or use the dense Gram
+    rules) instead.  ``p`` values the shard count does not divide fall
+    back to the unsharded accounting via ``active_param_shards(p)``.
     """
-    return max(
-        1, min(p, _CIRCULANT_CHUNK_BYTES // max(1, n * max(itemsize, floor)))
-    )
+    from murmura_tpu.parallel.mesh import active_param_shards
+
+    shards = active_param_shards(p)
+    cap = _CIRCULANT_CHUNK_BYTES // max(1, n * max(itemsize, floor))
+    chunk = max(1, min(p, cap * shards))
+    if shards > 1 and chunk < p:
+        # Align the (rare) still-chunked case to whole shard-local
+        # widths: nchunks = ceil(p/chunk) grows until it divides the
+        # shard count's column grid (bounded scan, trace-time only).
+        p_local = p // shards
+        chunk = max(p_local, (chunk // p_local) * p_local)
+    return chunk
 
 
 def _p_chunked_accumulate(arrays, chunk_fn, acc_init, p: int, chunk: int):

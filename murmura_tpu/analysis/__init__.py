@@ -44,6 +44,7 @@ def run_check_detailed(
     adaptive: Optional[bool] = None,
     staleness: Optional[bool] = None,
     pipeline: Optional[bool] = None,
+    sharded: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -68,12 +69,17 @@ def run_check_detailed(
     (analysis/pipeline.py, MUR1200-1203: pipeline-state registry
     bijection, zero recompiles across buffer swaps,
     collective-inventory parity with the serialized program, and the
-    delayed-step influence/lagging-verdict taint runs).
+    delayed-step influence/lagging-verdict taint runs), and when
+    ``sharded`` is enabled the param-axis sharding contracts
+    (analysis/sharded.py, MUR1300-1303: sharded-P collective
+    inventory — ppermute-only on "nodes", one small psum over "param"
+    — zero recompiles across sharded rounds, shards=1 bit-parity with
+    the unsharded program, and sharded execution parity).
     ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
-    ``staleness=None``/``pipeline=None`` mean "on for the package
-    check, off for explicit paths" (all six passes are package-global:
-    they exercise the live registry, not the files named on the
-    command line).
+    ``staleness=None``/``pipeline=None``/``sharded=None`` mean "on for
+    the package check, off for explicit paths" (all seven passes are
+    package-global: they exercise the live registry, not the files
+    named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -87,6 +93,7 @@ def run_check_detailed(
     run_adaptive = adaptive if adaptive is not None else not paths
     run_staleness = staleness if staleness is not None else not paths
     run_pipeline = pipeline if pipeline is not None else not paths
+    run_sharded = sharded if sharded is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -122,6 +129,10 @@ def run_check_detailed(
         from murmura_tpu.analysis import pipeline as pipeline_mod
 
         findings.extend(pipeline_mod.check_pipeline())
+    if run_sharded:
+        from murmura_tpu.analysis import sharded as sharded_mod
+
+        findings.extend(sharded_mod.check_sharded())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -135,12 +146,14 @@ def run_check(
     adaptive: Optional[bool] = None,
     staleness: Optional[bool] = None,
     pipeline: Optional[bool] = None,
+    sharded: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
         adaptive=adaptive, staleness=staleness, pipeline=pipeline,
+        sharded=sharded,
     )[0]
 
 

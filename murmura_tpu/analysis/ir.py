@@ -127,7 +127,8 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 # runners composed by run_check_detailed.
 _CHECK_ENTRY_POINTS = frozenset(
     {"check_ir", "check_coverage", "check_flow", "check_durability",
-     "check_adaptive", "check_staleness", "check_pipeline"}
+     "check_adaptive", "check_staleness", "check_pipeline",
+     "check_sharded"}
 )
 
 
@@ -1673,6 +1674,13 @@ def check_coverage() -> List[Finding]:
     findings.extend(
         _unwired_family_findings(
             pipeline_mod, pipeline_mod.PIPELINE_CHECK_FAMILIES
+        )
+    )
+    from murmura_tpu.analysis import sharded as sharded_mod
+
+    findings.extend(
+        _unwired_family_findings(
+            sharded_mod, sharded_mod.SHARDED_CHECK_FAMILIES
         )
     )
     return findings

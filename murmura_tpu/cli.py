@@ -659,6 +659,16 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "for the package check, off when explicit PATHS are given.",
 )
 @click.option(
+    "--sharded/--no-sharded", "sharded", default=None,
+    help="Run the param-axis sharding contracts (MUR1300-1303: sharded-P "
+         "collective inventory — ppermute-only on 'nodes', one small "
+         "psum over 'param' — zero recompiles across sharded rounds, "
+         "shards=1 bit-parity with the unsharded program, sharded "
+         "execution parity).  Compiles and runs tiny sharded programs "
+         "(~1 min on CPU).  Default: on for the package check, off when "
+         "explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary records) as JSON "
          "lines for editor/CI annotation instead of the greppable text "
@@ -670,7 +680,7 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "review the diff as perf history.",
 )
 def check(paths, contracts, ir, flow, durability, adaptive, staleness,
-          pipeline, as_json, update_budgets):
+          pipeline, sharded, as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -682,8 +692,10 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     influence bounds, NaN/attack scrub dominance, zero-free denominators),
     the durability contracts (MUR900 snapshot completeness via
     --contracts; MUR901/902 resume determinism via --durability), the
-    adaptive-adversary contracts (MUR1000-1003 via --adaptive), and the
-    bounded-staleness contracts (MUR1100-1103 via --staleness).
+    adaptive-adversary contracts (MUR1000-1003 via --adaptive), the
+    bounded-staleness contracts (MUR1100-1103 via --staleness), the
+    pipelined-rounds contracts (MUR1200-1203 via --pipeline), and the
+    param-axis sharding contracts (MUR1300-1303 via --sharded).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -706,7 +718,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     findings, records = run_check_detailed(
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
         durability=durability, adaptive=adaptive, staleness=staleness,
-        pipeline=pipeline,
+        pipeline=pipeline, sharded=sharded,
     )
     if as_json:
         out = format_findings_json(findings, records)

@@ -890,6 +890,22 @@ class TPUConfig(_Strict):
             "O(degree); right for ring/k-regular at large N)"
         ),
     )
+    param_shards: int = Field(
+        default=1,
+        ge=1,
+        description=(
+            "Param-axis sharding (docs/PERFORMANCE.md 'Param-axis "
+            "sharding'): split the flattened parameter vector over a "
+            "third ('seed', 'nodes', 'param') mesh axis so every [N, P] "
+            "round tensor — broadcast, stale cache, pipeline buffers, EF "
+            "residual, the aggregation output — is resident at "
+            "N x P/shards per device (ZeRO-style, arXiv:2004.13336).  "
+            "The flat vector zero-pads to a multiple of the shard count; "
+            "1 (default) is byte-identical to the unsharded program.  "
+            "Largest-dividing-factor fallback picks the actual mesh axis "
+            "when the device count cannot honor the full request."
+        ),
+    )
     param_dtype: Optional[Literal["float32", "bfloat16"]] = Field(
         default=None,
         description=(
@@ -1368,6 +1384,47 @@ class Config(_Strict):
                 "cohort swaps reassign node slots, so a buffered row "
                 "would be aggregated into the wrong user's stream — the "
                 "compression/staleness carried-state rationale)"
+            )
+        return self
+
+    @model_validator(mode="after")
+    def _param_shards_are_wirable(self):
+        s = self.tpu.param_shards
+        if s == 1:
+            return self
+        if self.backend != "tpu":
+            raise ValueError(
+                "tpu.param_shards > 1 requires backend: tpu — the param "
+                "axis is a mesh axis; the simulation backend has no mesh "
+                "to shard over"
+            )
+        if self.dmtt is not None:
+            raise ValueError(
+                "tpu.param_shards does not compose with dmtt (the N x N "
+                "claim cross-evaluation unravels every broadcast row into "
+                "a full model per pair — there is no sharded formulation "
+                "of that sweep)"
+            )
+        if self.compression.algorithm == "topk":
+            raise ValueError(
+                "tpu.param_shards does not compose with compression."
+                "algorithm: topk (the per-row global top-k needs the full "
+                "[P] row resident on one device, defeating the shard); "
+                "use the int8 codec — its per-block scales shard with P"
+            )
+        if self.sweep is not None:
+            raise ValueError(
+                "tpu.param_shards does not compose with sweep (gang "
+                "batching) yet — the gang's [S, N, P] stacked state would "
+                "need a fourth mesh role; run param-sharded experiments "
+                "unganged"
+            )
+        if self.population is not None and self.population.enabled:
+            raise ValueError(
+                "tpu.param_shards does not compose with population yet "
+                "(the memmapped user bank stages full [P] rows per cohort "
+                "swap; a sharded bank is ROADMAP item 5's sharded-bank "
+                "leg)"
             )
         return self
 

@@ -345,8 +345,28 @@ class Network:
         """
         if self._node_s is None or jax.process_count() > 1:
             return
-        from murmura_tpu.parallel.mesh import _shard_leading_axis
+        from murmura_tpu.parallel.mesh import (
+            _shard_leading_axis,
+            mesh_param_shards,
+            state_sharding_specs,
+        )
 
+        if self.mesh is not None and mesh_param_shards(self.mesh) > 1:
+            # Param-sharded placement: [N, flat_dim] leaves (the stale
+            # cache, pipeline buffers, EF residual) land column-split
+            # over the "param" axis — the layout the jit expects, so the
+            # first call (and every restore) stays reshard-free.
+            flat_dim = self.program.flat_dim or self.program.model_dim
+            place = lambda tree: jax.device_put(  # noqa: E731
+                tree, state_sharding_specs(tree, self.mesh, flat_dim)
+            )
+            self.params = place(self.params)
+            self.agg_state = place(self.agg_state)
+            self._data = jax.device_put(
+                self._data,
+                _shard_leading_axis(self._data, self._node_s, self._repl),
+            )
+            return
         place = lambda tree: jax.device_put(  # noqa: E731
             tree, _shard_leading_axis(tree, self._node_s, self._repl)
         )
@@ -873,10 +893,16 @@ class Network:
 
     def _durability_extra_state(self):
         """(arrays, meta) extra sections; the base orchestrator carries
-        only the telemetry run id (stable across resumes — writer.py)."""
+        the telemetry run id (stable across resumes — writer.py) and, for
+        param-sharded programs, the shard count (gather-on-save makes the
+        *values* layout-free, but the flat PAD is a function of the shard
+        count, so a different-shard restore must refuse loudly instead of
+        loading a wrong-width cache row)."""
         meta = {}
         if self.telemetry is not None:
             meta["telemetry_run_id"] = self.telemetry.run_id
+        if self.program.param_shards > 1:
+            meta["param_shards"] = int(self.program.param_shards)
         return {}, meta
 
     def _durability_validate_extra(self, arrays, meta) -> None:
@@ -892,6 +918,23 @@ class Network:
                 f"snapshot carries extra sections {foreign} this "
                 "orchestrator does not understand — it was written by a "
                 "population/gang run; rebuild with the matching config"
+            )
+        snap_shards = int(meta.get("param_shards", 1))
+        ours = int(self.program.param_shards)
+        if snap_shards != ours:
+            # The flat pad is shards-dependent (ops/flatten.padded_dim),
+            # so even when two shard counts happen to produce the same
+            # padded width, a cross-shard restore is a different program
+            # family — refuse loudly, symmetric with the gang/population
+            # identity guards (satellite: restoring a 4-shard snapshot
+            # into a 2-shard mesh must refuse, not silently reshard).
+            raise ValueError(
+                f"snapshot was written by a param-sharded run with "
+                f"tpu.param_shards={snap_shards} but this run has "
+                f"param_shards={ours} — the flat pad (and the mesh "
+                "layout the cache rows restore into) is a function of "
+                "the shard count; rebuild with the matching "
+                "tpu.param_shards"
             )
 
     def _durability_restore_extra(self, arrays, meta) -> None:

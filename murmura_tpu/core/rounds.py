@@ -66,7 +66,8 @@ from murmura_tpu.ops.compress import (
     compress_exchange,
     init_compress_state,
 )
-from murmura_tpu.ops.flatten import make_flatteners
+from murmura_tpu.ops.flatten import make_flatteners, make_sharded_flatteners
+from murmura_tpu.parallel.mesh import constrain_flat, constrain_replicated
 from murmura_tpu.ops.losses import (
     evidential_loss,
     masked_cross_entropy,
@@ -153,6 +154,17 @@ class RoundProgram:
     # (jit DCEs the attack/codec/exchange stages), present on every
     # build.
     train_flat: Optional[Callable] = None
+    # Param-axis sharding (parallel/mesh.py, docs/PERFORMANCE.md
+    # "Param-axis sharding"): the flat vector is zero-padded so this
+    # shard count divides its width, and on a ("seed", "nodes", "param")
+    # mesh every [N, flat_dim] tensor — broadcast, stale cache, pipeline
+    # buffers, EF residual/top-k reference, the aggregation output —
+    # shards its columns over the param axis.  1 (default) => flat_dim ==
+    # model_dim and the traced program is byte-identical to pre-sharding
+    # builds (MUR1302).
+    param_shards: int = 1
+    # Padded flat width (== model_dim unless param_shards pads it).
+    flat_dim: int = 0
 
     @property
     def sparse(self) -> bool:
@@ -192,6 +204,7 @@ def build_round_program(
     compression: Optional[CompressionSpec] = None,
     staleness: Optional[StalenessSpec] = None,
     pipeline: bool = False,
+    param_shards: int = 1,
 ) -> RoundProgram:
     """Trace-ready round step for a network of ``data.num_nodes`` nodes.
 
@@ -234,6 +247,30 @@ def build_round_program(
     n = data.num_nodes
     num_classes = data.num_classes or model.num_classes
     evidential = model.evidential
+
+    # Param-axis sharding (tpu.param_shards; docs/PERFORMANCE.md
+    # "Param-axis sharding"): the flat vector pads to a multiple of the
+    # shard count and every [N, P]-shaped tensor of the round shards its
+    # columns over the mesh's "param" axis.  Mode rejections are loud and
+    # config-time, like every other exchange-mode combination above.
+    param_shards = int(param_shards)
+    if param_shards < 1:
+        raise ValueError(f"param_shards must be >= 1, got {param_shards}")
+    if param_shards > 1:
+        if dmtt is not None:
+            raise ValueError(
+                "param-axis sharding does not compose with DMTT (the "
+                "N x N claim cross-evaluation unravels every broadcast "
+                "row into a full model per pair — there is no sharded "
+                "formulation of that sweep)"
+            )
+        if compression is not None and compression.algorithm == "topk":
+            raise ValueError(
+                "param-axis sharding does not compose with topk "
+                "compression: the per-row global top-k needs the full "
+                "[P] row resident on one device, defeating the shard — "
+                "use the int8 codec (its per-block scales shard with P)"
+            )
 
     # Sparse exchange mode: the adjacency input is the [k, N] per-offset
     # edge mask of a SparseTopology (edge i <- (i + o) % N active), never a
@@ -387,7 +424,28 @@ def build_round_program(
             lambda l: l.astype(dt), init_params
         )
     template = jax.tree_util.tree_map(lambda l: l[0], init_params)
-    ravel, unravel, model_dim = make_flatteners(template)
+    if param_shards > 1:
+        ravel, unravel, model_dim, flat_dim = make_sharded_flatteners(
+            template, param_shards
+        )
+    else:
+        ravel, unravel, model_dim = make_flatteners(template)
+        flat_dim = model_dim
+    if param_shards > 1 and compression is not None:
+        # int8 per-block scales must shard WITH the payload: a quant block
+        # straddling a shard boundary would compute its scale from two
+        # shards' columns (a silent cross-shard amax collective every
+        # round) — reject at config time, loudly.
+        local = flat_dim // param_shards
+        if local % compression.block:
+            raise ValueError(
+                f"compression.block={compression.block} does not divide "
+                f"the shard-local flat width {local} (flat_dim "
+                f"{flat_dim} over {param_shards} param shards) — a quant "
+                "block straddling a shard boundary would compute its "
+                "scale across shards; pick a block dividing "
+                f"{local} (or adjust tpu.param_shards)"
+            )
 
     # ---- probe batches for loss/trust-probe rules ------------------------
     p_size = int(min(data.max_samples, probe_size or global_batch))
@@ -439,7 +497,14 @@ def build_round_program(
         def epoch_body(params, epoch_key):
             perm_key, step_key = jax.random.split(epoch_key)
             # Shuffle valid samples to the front: invalid slots sort last.
-            u = jax.random.uniform(perm_key, d["mask"].shape) + (1.0 - d["mask"]) * 10.0
+            # The draw is pinned replicated under a param-sharded mesh
+            # (identity otherwise): the legacy threefry lowering is
+            # sharding-dependent, and an output partitioned over "param"
+            # would shuffle DIFFERENT batches than the unsharded program
+            # (parallel/mesh.constrain_replicated).
+            u = constrain_replicated(
+                jax.random.uniform(perm_key, d["mask"].shape)
+            ) + (1.0 - d["mask"]) * 10.0
             perm = jnp.argsort(u, axis=1)  # [N, S]
 
             def step_body(params, t):
@@ -600,7 +665,7 @@ def build_round_program(
             # exchange mode runs the same fold in [k, N] edge-mask space.)
             adj = _edges_mask_both(adj, alive)
             train_mask = train_mask * alive
-            pre_flat = jax.vmap(ravel)(params)
+            pre_flat = constrain_flat(jax.vmap(ravel)(params))
         # named_scope brackets label the `# murmura: traced` phases in
         # profiler traces (xprof/perfetto op names) — metadata only, the
         # lowered program is identical (the telemetry-off byte-identity
@@ -608,8 +673,11 @@ def build_round_program(
         with jax.named_scope("murmura.train"):
             params = local_training(params, d, train_mask, train_key, round_idx)
 
-        # 2. snapshot + attack on outgoing states (network.py:105-119)
-        own_flat = jax.vmap(ravel)(params)
+        # 2. snapshot + attack on outgoing states (network.py:105-119).
+        # constrain_flat pins the [N, P] tensors to ("nodes", "param")
+        # when a param-sharded mesh scope is active (parallel/mesh.py) —
+        # identity otherwise, so unsharded programs are byte-identical.
+        own_flat = constrain_flat(jax.vmap(ravel)(params))
         fault_stats = {}
         if _inject_rows is not None:
             # Deterministic divergence injection (chaos testing): scheduled
@@ -766,7 +834,7 @@ def build_round_program(
         return {
             "params": params,
             "own_flat": own_flat,
-            "bcast": bcast,
+            "bcast": constrain_flat(bcast),
             "adj": adj,
             "pre_flat": pre_flat if alive is not None else None,
             "finite": finite,
@@ -847,6 +915,7 @@ def build_round_program(
             new_flat, rule_state, agg_stats = agg.aggregate(
                 own_flat, bcast, adj, round_idx, rule_state, step_ctx
             )
+        new_flat = constrain_flat(new_flat)
         agg_state = {**agg_state, **rule_state}
 
         # 3b. adaptive-attack feedback (attacks/adaptive.py): the attacker
@@ -946,6 +1015,7 @@ def build_round_program(
             agg_out, rule_state_new, agg_stats = agg.aggregate(
                 buf_own, buf_bcast, buf_adj, agg_ridx, rule_state, step_ctx
             )
+            agg_out = constrain_flat(agg_out)
         if alive is not None:
             # The serialized zero-alive-neighbor guard, applied at the
             # buffered graph (a sender-isolated receiver at round r-1
@@ -1096,8 +1166,10 @@ def build_round_program(
                 "exchange"
             )
         leaf = jax.tree_util.tree_leaves(init_params)[0]
+        # flat_dim, not model_dim: the cache row must match the (padded)
+        # exchanged width so it shards over "param" with the broadcast.
         init_agg_state.update(
-            init_stale_state(staleness, n, model_dim, leaf.dtype)
+            init_stale_state(staleness, n, flat_dim, leaf.dtype)
         )
     if adaptive:
         # Adaptation state rides agg_state under the attack's reserved
@@ -1133,7 +1205,7 @@ def build_round_program(
         leaf = jax.tree_util.tree_leaves(init_params)[0]
         init_agg_state.update(
             init_pipeline_state(
-                n, model_dim, leaf.dtype,
+                n, flat_dim, leaf.dtype,
                 sparse_offsets=sparse_offsets,
                 stale=staleness is not None,
             )
@@ -1156,6 +1228,8 @@ def build_round_program(
         staleness=staleness,
         pipelined=pipeline,
         train_flat=train_flat,
+        param_shards=param_shards,
+        flat_dim=flat_dim,
     )
 
 

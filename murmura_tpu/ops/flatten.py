@@ -61,6 +61,48 @@ def make_flatteners(
     return ravel, unravel, int(flat0.size)
 
 
+def padded_dim(dim: int, multiple: int) -> int:
+    """``dim`` rounded up to a whole multiple of ``multiple`` — the padded
+    flat width of a param-sharded program (docs/PERFORMANCE.md "Param-axis
+    sharding").  The pad is what lets the ``"param"`` mesh axis split the
+    flat vector into equal shards for ANY model size."""
+    if multiple < 1:
+        raise ValueError(f"pad multiple must be >= 1, got {multiple}")
+    return -(-int(dim) // int(multiple)) * int(multiple)
+
+
+def make_sharded_flatteners(
+    template: Any, param_shards: int
+) -> Tuple[Callable[[Any], jnp.ndarray], Callable[[jnp.ndarray], Any], int, int]:
+    """Build (ravel, unravel, dim, flat_dim) with the flat vector zero-padded
+    so ``param_shards`` divides its width.
+
+    ``ravel`` emits [flat_dim] rows whose last ``flat_dim - dim`` columns are
+    exact zeros; ``unravel`` strips the pad before reconstructing the pytree.
+    Exact-zero padding is inert through every consumer by the same algebra
+    the int8 codec's block padding relies on (ops/compress.py): distances add
+    (0-0)^2, means of zeros stay zero, and the optimizer update never reads
+    the pad back (unravel slices it off).  At ``param_shards=1`` (or when the
+    shard count already divides the dimension) this degenerates to
+    :func:`make_flatteners` exactly — flat_dim == dim and ravel/unravel are
+    the unpadded pair, so the shards=1 program is byte-identical (MUR1302).
+    """
+    ravel0, unravel0, dim = make_flatteners(template)
+    flat_dim = padded_dim(dim, param_shards)
+    if flat_dim == dim:
+        return ravel0, unravel0, dim, dim
+
+    pad = flat_dim - dim
+
+    def ravel(tree: Any) -> jnp.ndarray:
+        return jnp.pad(ravel0(tree), (0, pad))
+
+    def unravel(flat: jnp.ndarray) -> Any:
+        return unravel0(flat[:dim])
+
+    return ravel, unravel, dim, flat_dim
+
+
 def model_dimension(template: Any) -> int:
     """Total float parameter count (reference: aggregation/base.py:155-170).
 

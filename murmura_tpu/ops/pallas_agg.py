@@ -33,9 +33,17 @@ Deployment contract (mirrors ``ops/pallas_sketch.py``):
   parity with the lax reference path is tested everywhere
   (tests/test_pallas_agg.py).
 - Opt-in via ``tpu.pallas_agg: true`` (or ``MURMURA_PALLAS_AGG=1``), wired
-  by the factories as an aggregator param; off by default and never
-  enabled on a sharded node axis (pallas_call does not decompose under
-  GSPMD — the sharded path keeps the lax kernels).
+  by the factories as an aggregator param; off by default.  Sharded-axis
+  policy (precise, per entry point): a sharded **nodes** axis is refused
+  (in-kernel rolls are node-axis wrap-arounds; pallas_call does not
+  decompose under GSPMD) — the entry points return ``None`` and callers
+  keep the lax kernels.  A sharded **param** axis is accepted with
+  SHARD-LOCAL grids: the kernel runs under ``shard_map`` over the mesh's
+  ``"param"`` axis on each device's own column block, and the distance
+  kernels finish with one small ``psum`` of the [k, N]/[N, M] scalars —
+  exactly the sharded-P collective contract (MUR1300).  Anything else
+  (both axes sharded, a width the shard count does not divide) falls back
+  to lax by returning ``None``.
 - Each entry point returns ``None`` when the shapes fall outside the
   kernel's support envelope (tiling alignment on a real TPU, VMEM budget);
   callers (aggregation/base.py) fall back to the lax path, so enabling the
@@ -65,6 +73,64 @@ _VMEM_BLOCK_BYTES = 4 * 1024 * 1024
 # Hard cap on the resident accumulator (pairwise kernel holds [N, M] f32
 # in VMEM for the whole sweep).
 _MAX_PAIRWISE_CELLS = 1024 * 1024
+
+
+def _sharded_axis_mode():
+    """(mode, mesh) of the active param-axis trace scope
+    (parallel/mesh.py): ``("nodes", mesh)`` = a sharded node axis — every
+    entry point must REFUSE (return None; in-kernel rolls wrap at the
+    resident row count, which is wrong on a split node axis);
+    ``("param", mesh)`` = param-only sharding — run with shard-local
+    grids via :func:`_param_shard_map`; ``(None, None)`` = no sharded
+    scope (plain single-device call, or both axes size 1)."""
+    from murmura_tpu.parallel.mesh import (
+        active_param_scope,
+        mesh_node_axis,
+        mesh_param_shards,
+    )
+
+    scope = active_param_scope()
+    if scope is None:
+        return None, None
+    mesh = scope[0]
+    if mesh_node_axis(mesh) > 1:
+        return "nodes", mesh
+    if mesh_param_shards(mesh) > 1:
+        return "param", mesh
+    return None, None
+
+
+def _param_shard_map(fn, mesh, n_in: int, reduce_out: bool):
+    """Wrap a per-column-block kernel call for a param-sharded mesh:
+    inputs split their LAST axis over ``"param"`` (shard-local grids —
+    each device streams only its own columns), and the output either
+    ``psum``s over the param groups (distance accumulations: the one
+    small scalar collective of the sharded-P contract) or stays a
+    column-sharded map (candidate selection)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    col = P(None, "param")
+
+    def local(*blocks):
+        out = fn(*blocks)
+        if reduce_out:
+            out = jax.lax.psum(out, "param")
+        return out
+
+    return shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(col,) * n_in,
+        out_specs=P() if reduce_out else col,
+        check_rep=False,
+    )
+
+
+def _param_shards_of(mesh) -> int:
+    from murmura_tpu.parallel.mesh import mesh_param_shards
+
+    return mesh_param_shards(mesh)
 
 
 def _interpret_default() -> bool:
@@ -177,6 +243,18 @@ def circulant_sq_distances(
         return None
     if not _tiling_ok(interpret, n):
         return None
+    mode, mesh = _sharded_axis_mode()
+    if mode == "nodes":
+        return None  # rolls wrap at the resident row count — lax path
+    if mode == "param":
+        if p % _param_shards_of(mesh):
+            return None
+        return _param_shard_map(
+            lambda o_l, b_l: _circ_dist_call(
+                o_l, b_l, tuple(int(o) for o in offsets), interpret
+            ),
+            mesh, n_in=2, reduce_out=True,
+        )(own, bcast)
     return _circ_dist_call(own, bcast, tuple(int(o) for o in offsets), interpret)
 
 
@@ -257,6 +335,18 @@ def pairwise_sq_distances(
         return None  # the [N, M] accumulator must stay VMEM-resident
     if not interpret and (n % 8 != 0 or m % 128 != 0):
         return None
+    mode, mesh = _sharded_axis_mode()
+    if mode == "nodes":
+        return None  # the [N, M] accumulator spans the split node axis
+    if mode == "param":
+        if p % _param_shards_of(mesh):
+            return None
+        # Shard-local Gram/norm partials over each device's columns, one
+        # [N, M] psum at the end: d2 = sum over shards of local d2.
+        return _param_shard_map(
+            lambda a_l, b_l: _pairwise_call(a_l, b_l, interpret),
+            mesh, n_in=2, reduce_out=True,
+        )(a, b)
     return _pairwise_call(a, b, interpret)
 
 
@@ -339,6 +429,11 @@ def candidate_select_supported(
         return False
     if not interpret and bcast.shape[0] % 128 != 0:
         return False  # in-kernel rolls wrap at the resident row count
+    mode, mesh = _sharded_axis_mode()
+    if mode == "nodes":
+        return False  # rolls wrap at the resident row count — lax path
+    if mode == "param" and bcast.shape[1] % _param_shards_of(mesh):
+        return False  # columns must split evenly into shard-local grids
     return True
 
 
@@ -361,6 +456,17 @@ def fused_candidate_select(
         own, bcast, offsets, trim=0 if median else trim, interpret=interpret
     ):
         return None
+    mode, mesh = _sharded_axis_mode()
+    if mode == "param":
+        # Coordinate-wise along P: a pure shard-local map over each
+        # device's column block, no collective at all.
+        return _param_shard_map(
+            lambda o_l, b_l: _candidate_call(
+                o_l, b_l, tuple(int(o) for o in offsets), int(trim),
+                bool(median), interpret,
+            ),
+            mesh, n_in=2, reduce_out=False,
+        )(own, bcast)
     return _candidate_call(
         own, bcast, tuple(int(o) for o in offsets), int(trim), bool(median),
         interpret,

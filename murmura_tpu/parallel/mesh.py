@@ -16,7 +16,8 @@ traffic over DCN.  Tested virtually via
 __graft_entry__.dryrun_multichip).
 """
 
-from typing import Any, Optional
+import contextlib
+from typing import Any, List, Optional, Tuple
 
 import jax
 import numpy as np
@@ -106,6 +107,190 @@ def _shard_leading_axis(tree: Any, node_sharding, replicated) -> Any:
     return jax.tree_util.tree_map(spec, tree)
 
 
+# --------------------------------------------------------------------------
+# Param-axis sharding (docs/PERFORMANCE.md "Param-axis sharding"): a third
+# mesh axis splits the flattened parameter vector so every [N, P]-shaped
+# tensor of the round (the broadcast, the stale cache and pipeline buffers,
+# the EF residual / top-k reference, the aggregation output) is resident at
+# N x P/shards per device — the ZeRO-style cross-replica weight-update
+# sharding of arXiv:2004.13336 applied to the gossip round.  The model
+# pytree itself stays node-sharded (training needs each node's full model);
+# it is the flat [N, P] aggregation-side state that hits the memory wall
+# first, and that is what shards here.
+# --------------------------------------------------------------------------
+
+
+def plan_param_layout(
+    num_nodes: int, param_shards: int, n_dev: int
+) -> Tuple[int, int, int]:
+    """(seed, nodes, param) axis sizes for a param-sharded single-run mesh.
+
+    Largest-dividing-factor fallback (the :func:`make_gang_mesh` policy):
+    prefer the full requested ``param_shards`` on the param axis, else the
+    largest divisor of it that also divides the device count while leaving
+    a node axis that divides ``num_nodes``.  ``param_shards=1`` degrades to
+    the plain node layout.  Raises when no factorization fits.
+    """
+    if param_shards < 1:
+        raise ValueError(f"param_shards must be >= 1, got {param_shards}")
+    for s in sorted(
+        (d for d in range(1, param_shards + 1) if param_shards % d == 0),
+        reverse=True,
+    ):
+        if n_dev % s:
+            continue
+        nodes_ax = n_dev // s
+        if nodes_ax <= num_nodes and num_nodes % nodes_ax == 0:
+            return 1, nodes_ax, s
+    raise ValueError(
+        f"cannot lay {num_nodes} nodes x {param_shards} param shards onto "
+        f"{n_dev} devices: no (nodes, param) factorization divides both "
+        "axes — adjust tpu.num_devices or tpu.param_shards"
+    )
+
+
+def make_param_mesh(
+    num_nodes: int, param_shards: int, num_devices: Optional[int] = None
+) -> Mesh:
+    """3-D ("seed", "nodes", "param") mesh for a param-sharded single run.
+
+    The seed axis is size 1 (gangs get theirs from :func:`make_gang_mesh`);
+    the node and param axes factor the device count by
+    :func:`plan_param_layout`.  Every P("nodes")-spec'd consumer of the
+    1-D mesh works unchanged on this mesh (absent axes replicate), so the
+    orchestrator's sharding helpers are layout-agnostic.
+    """
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"Requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    seed_ax, nodes_ax, param_ax = plan_param_layout(
+        num_nodes, param_shards, len(devices)
+    )
+    sel = np.array(devices[: seed_ax * nodes_ax * param_ax])
+    return Mesh(
+        sel.reshape(seed_ax, nodes_ax, param_ax), ("seed", "nodes", "param")
+    )
+
+
+def mesh_param_shards(mesh: Optional[Mesh]) -> int:
+    """Size of the mesh's ``param`` axis (1 when absent or no mesh)."""
+    if mesh is None:
+        return 1
+    return int(dict(zip(mesh.axis_names, mesh.devices.shape)).get("param", 1))
+
+
+def mesh_node_axis(mesh: Optional[Mesh]) -> int:
+    """Size of the mesh's ``nodes`` axis (the whole mesh for legacy
+    unnamed consumers passing a 1-D mesh)."""
+    if mesh is None:
+        return 1
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    return int(sizes.get("nodes", mesh.devices.size))
+
+
+def flat_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding of a flat [N, P] round tensor on a param-sharded mesh:
+    rows over ``nodes``, columns over ``param``."""
+    return NamedSharding(mesh, P("nodes", "param"))
+
+
+# Trace-time ambient scope: (mesh, flat_dim) while a param-sharded round
+# program is being traced.  core/rounds.py pins its [N, P] intermediates
+# through :func:`constrain_flat`, aggregation/base.py aligns its P-chunk
+# loops through :func:`active_param_shards`, and ops/pallas_agg.py picks
+# shard-local grids through :func:`active_param_scope` — one context, three
+# consumers, zero plumbing through rule signatures.  Off-scope (simulation
+# backend, gang vmap, shards=1) every hook is the identity, keeping those
+# programs byte-identical (MUR1302).
+_PARAM_SCOPE: List[Tuple[Mesh, int]] = []
+
+
+@contextlib.contextmanager
+def param_axis_scope(mesh: Mesh, flat_dim: int):
+    """Activate the param-axis trace scope (see module note above)."""
+    _PARAM_SCOPE.append((mesh, int(flat_dim)))
+    try:
+        yield
+    finally:
+        _PARAM_SCOPE.pop()
+
+
+def active_param_scope() -> Optional[Tuple[Mesh, int]]:
+    """(mesh, flat_dim) of the innermost active scope, or None."""
+    return _PARAM_SCOPE[-1] if _PARAM_SCOPE else None
+
+
+def active_param_shards(p: Optional[int] = None) -> int:
+    """Param-shard count of the active scope (1 off-scope).  With ``p``
+    given, returns 1 unless the shard count divides ``p`` — callers
+    slicing a [*, p] tensor must not assume shard alignment the tensor
+    does not have (e.g. the int8 codec's block-padded width)."""
+    scope = active_param_scope()
+    if scope is None:
+        return 1
+    shards = mesh_param_shards(scope[0])
+    if p is not None and p % shards:
+        return 1
+    return shards
+
+
+def constrain_flat(x):
+    """Pin a flat [N, P] round tensor to ("nodes", "param") when a
+    param-axis scope is active; identity otherwise (and for any value
+    whose trailing width is not the scope's flat_dim).  Traced as a no-op
+    off-scope, so unsharded programs are byte-identical."""
+    scope = active_param_scope()
+    if scope is None:
+        return x
+    mesh, flat_dim = scope
+    if getattr(x, "ndim", 0) == 2 and x.shape[-1] == flat_dim:
+        return jax.lax.with_sharding_constraint(x, flat_sharding(mesh))
+    return x
+
+
+def constrain_replicated(x):
+    """Pin a value REPLICATED across the active param-sharded mesh;
+    identity off-scope.
+
+    The one consumer is the round program's RNG draws (core/rounds.py
+    ``local_training``): the legacy (non-partitionable) threefry lowering
+    is sharding-DEPENDENT — the same key produces different uniforms when
+    GSPMD partitions the output over a ("nodes", "param") mesh than on one
+    device — so an unpinned draw would give every mesh layout its own
+    batch permutations, breaking cross-layout comparability (and the
+    shards=1-vs-sharded parity MUR1303 measures).  Replicating the draw
+    keeps the bits byte-identical to the unsharded program; the arrays are
+    [N, S]-scale (batch schedule), so the cost is noise next to the [N, P]
+    state the param axis exists to shard."""
+    scope = active_param_scope()
+    if scope is None:
+        return x
+    mesh = scope[0]
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P()))
+
+
+def state_sharding_specs(tree: Any, mesh: Mesh, flat_dim: int) -> Any:
+    """Sharding pytree for param-sharded resident state: [N, flat_dim]
+    leaves split ("nodes", "param") — the stale cache, pipeline buffers,
+    EF residual and top-k reference — everything else keeps the
+    leading-axis ``nodes`` layout of :func:`_shard_leading_axis`."""
+    node_s, repl = make_shardings(mesh)
+    flat_s = flat_sharding(mesh)
+
+    def spec(leaf):
+        if not hasattr(leaf, "ndim") or leaf.ndim == 0:
+            return repl
+        if leaf.ndim == 2 and leaf.shape[-1] == flat_dim:
+            return flat_s
+        return node_s
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
 def _shard_round_fn(
     fn, program, mesh: Mesh, adj_sharding, donate: bool, alive_sharding=None
 ):
@@ -123,15 +308,44 @@ def _shard_round_fn(
     spans multiple processes (multi-host: a node-sharded output would span
     non-addressable devices).
     """
-    n_dev = mesh.devices.size
-    if program.num_nodes % n_dev != 0:
+    node_ax = mesh_node_axis(mesh)
+    if program.num_nodes % node_ax != 0:
         raise ValueError(
-            f"num_nodes={program.num_nodes} not divisible by mesh size {n_dev}"
+            f"num_nodes={program.num_nodes} not divisible by mesh node "
+            f"axis {node_ax}"
         )
     node_s, repl = make_shardings(mesh)
 
-    params_s = _shard_leading_axis(program.init_params, node_s, repl)
-    agg_s = _shard_leading_axis(program.init_agg_state, node_s, repl)
+    param_ax = mesh_param_shards(mesh)
+    if param_ax > 1:
+        # Param-sharded layout: the program must have been built with a
+        # matching shard count — its flat width is padded to a multiple of
+        # program.param_shards, and the mesh axis must divide that pad.
+        shards = getattr(program, "param_shards", 1)
+        flat_dim = getattr(program, "flat_dim", program.model_dim)
+        if shards % param_ax or flat_dim % param_ax:
+            raise ValueError(
+                f"mesh param axis {param_ax} does not divide the round "
+                f"program's param_shards={shards} (flat width {flat_dim}) "
+                "— build the program with "
+                f"build_round_program(param_shards={param_ax}) (config: "
+                "tpu.param_shards) so the flat pad matches the mesh"
+            )
+        params_s = state_sharding_specs(program.init_params, mesh, flat_dim)
+        agg_s = state_sharding_specs(program.init_agg_state, mesh, flat_dim)
+        # The [N, P] intermediates inside the round body (own_flat, the
+        # broadcast, the aggregation output) are pinned by constrain_flat
+        # at trace time — activate the ambient scope around the traced
+        # body so rounds.py / aggregation kernels see the layout.
+        inner = fn
+
+        def fn(*args):  # murmura: traced
+            with param_axis_scope(mesh, flat_dim):
+                return inner(*args)
+
+    else:
+        params_s = _shard_leading_axis(program.init_params, node_s, repl)
+        agg_s = _shard_leading_axis(program.init_agg_state, node_s, repl)
     data_s = _shard_leading_axis(program.data_arrays, node_s, repl)
 
     in_shardings = [

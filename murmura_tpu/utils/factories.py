@@ -370,8 +370,11 @@ def build_staleness_spec(config: Config, topology):
 def pallas_agg_enabled(config: Config, node_axis_sharded: bool) -> bool:
     """Whether to route this build's aggregation through the fused Pallas
     kernels (tpu.pallas_agg, env twin MURMURA_PALLAS_AGG=1).  Never on a
-    sharded node axis — pallas_call does not decompose under GSPMD, so the
-    sharded path keeps the lax kernels."""
+    sharded NODE axis — pallas_call does not decompose under GSPMD, so
+    that path keeps the lax kernels.  A sharded *param* axis is fine: the
+    entry points themselves run shard-local grids under shard_map
+    (ops/pallas_agg.py sharded-axis policy), so the toggle stays honest
+    per axis rather than per mesh."""
     import os
 
     if node_axis_sharded:
@@ -491,21 +494,39 @@ def apply_compilation_cache(config: Config) -> None:
 
 
 def _node_axis_sharded(config: Config, mesh=None) -> bool:
-    """Whether the round step will run with the node axis sharded over a
+    """Whether the round step will run with the NODE axis sharded over a
     mesh — selects circulant shift lowerings (AggContext.node_axis_sharded).
     An explicitly passed mesh is authoritative (it IS the thing this flag
-    describes); otherwise ``tpu.num_devices: null`` means "all available",
-    so the device count is only known at build time."""
+    describes) and is read per axis: a ("seed", "nodes", "param") mesh
+    whose node axis is size 1 is NOT node-sharded however many param
+    shards it carries.  Otherwise ``tpu.num_devices: null`` means "all
+    available", so the device count is only known at build time — with
+    param sharding configured, the node axis gets what the planned layout
+    leaves it (parallel/mesh.plan_param_layout)."""
     if config.backend != "tpu":
         return False
     if mesh is not None:
-        return mesh.size > 1
-    nd = config.tpu.num_devices
-    if nd is not None:
-        return nd > 1
-    import jax
+        from murmura_tpu.parallel.mesh import mesh_node_axis
 
-    return jax.device_count() > 1
+        return mesh_node_axis(mesh) > 1
+    nd = config.tpu.num_devices
+    if nd is None:
+        import jax
+
+        nd = jax.device_count()
+    if config.tpu.param_shards > 1:
+        from murmura_tpu.parallel.mesh import plan_param_layout
+
+        try:
+            _, nodes_ax, _ = plan_param_layout(
+                config.topology.num_nodes, config.tpu.param_shards, nd
+            )
+        except ValueError:
+            # Unfactorable layouts fail loudly at mesh build; the lowering
+            # flag just needs a consistent answer until then.
+            return nd > 1
+        return nodes_ax > 1
+    return nd > 1
 
 
 def build_gang_from_config(config: Config, seeds=None, mesh=None,
@@ -540,6 +561,18 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             "gang-batched sweeps need the jitted backends; backend: "
             "distributed trains in per-node OS processes (run seeds as "
             "separate invocations there)"
+        )
+    if config.backend == "tpu" and config.tpu.param_shards > 1:
+        # The schema validator catches sweep-block configs; the CLI
+        # `--seeds N` path reaches here with sweep=None and would
+        # otherwise DROP the requested sharding silently (the gang mesh
+        # has no param role yet) — at the model sizes the axis exists
+        # for, that is an OOM instead of a refusal.
+        raise ConfigError(
+            "tpu.param_shards does not compose with gang-batched "
+            "execution (murmura sweep / run --seeds) yet — the gang's "
+            "[S, N, P] stacked state would need a fourth mesh role; run "
+            "param-sharded experiments unganged"
         )
     if config.backend == "tpu" and config.tpu.multihost and mesh is None:
         from murmura_tpu.parallel.mesh import init_multihost
@@ -881,7 +914,7 @@ def build_network_from_config(
     # a throwaway init (cheap, host-side).
     import jax
 
-    from murmura_tpu.ops.flatten import model_dimension
+    from murmura_tpu.ops.flatten import model_dimension, padded_dim
 
     model_dim = model_dimension(
         jax.eval_shape(model.init, jax.random.PRNGKey(0))
@@ -890,8 +923,30 @@ def build_network_from_config(
         # Fused Pallas aggregation kernels (ops/pallas_agg.py); rules that
         # have no kernel path ignore the param.
         agg_params.setdefault("pallas", True)
+    # Param-axis sharding pads the flat width; rules that size buffers
+    # from the flat dimension (sketchguard's tables, krum candidate math)
+    # must see the PADDED width — the width their [N, P] operand will
+    # actually have.  The pad columns are exact zeros, inert everywhere.
+    param_shards = config.tpu.param_shards if config.backend == "tpu" else 1
+    agg_flat_dim = padded_dim(model_dim, param_shards)
+    if (
+        param_shards > 1
+        and config.compression.algorithm == "int8"
+        and (agg_flat_dim // param_shards) % config.compression.block
+    ):
+        # The build_round_program backstop raises the same refusal; here
+        # it renders as a config message with the concrete numbers.
+        raise ConfigError(
+            f"compression.block={config.compression.block} does not "
+            f"divide the shard-local flat width "
+            f"{agg_flat_dim // param_shards} (model_dim {model_dim} "
+            f"padded to {agg_flat_dim} over tpu.param_shards="
+            f"{param_shards}) — a quant block straddling a shard "
+            "boundary would compute its scale across shards; pick a "
+            "block that divides the shard-local width"
+        )
     agg = build_aggregator(
-        config.aggregation.algorithm, agg_params, model_dim=model_dim,
+        config.aggregation.algorithm, agg_params, model_dim=agg_flat_dim,
         total_rounds=rounds,
     )
 
@@ -923,12 +978,20 @@ def build_network_from_config(
         compression=build_compression_spec(config),
         staleness=build_staleness_spec(config, topology),
         pipeline=config.exchange.pipeline,
+        param_shards=param_shards,
     )
 
     if config.backend == "tpu" and mesh is None:
-        from murmura_tpu.parallel.mesh import make_mesh
+        if param_shards > 1:
+            from murmura_tpu.parallel.mesh import make_param_mesh
 
-        mesh = make_mesh(config.tpu.num_devices)
+            mesh = make_param_mesh(
+                n, param_shards, config.tpu.num_devices
+            )
+        else:
+            from murmura_tpu.parallel.mesh import make_mesh
+
+            mesh = make_mesh(config.tpu.num_devices)
 
     net_kwargs = dict(
         program=program,

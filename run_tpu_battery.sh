@@ -18,6 +18,7 @@ RESUME=0
 FRONTIER=0
 STALE=0
 PIPELINE=0
+SHARDED=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -29,6 +30,7 @@ while :; do
     --frontier) FRONTIER=1; shift;;
     --stale) STALE=1; shift;;
     --pipeline) PIPELINE=1; shift;;
+    --sharded) SHARDED=1; shift;;
     *) break;;
   esac
 done
@@ -461,6 +463,71 @@ PYEOF
     exit 1
   fi
   tail -1 "$OUT/preflight_pipeline_tpu.out" | tee -a "$OUT/battery.log"
+fi
+# Optional param-axis sharding pre-flight (./run_tpu_battery.sh --sharded
+# [outdir]): the ISSUE-15 gates on a forced 8-virtual-device CPU mesh —
+# (a) the MUR1300-1303 family must be clean (sharded-P collective
+# inventory ppermute-only on "nodes" plus one small psum over "param";
+# zero recompiles across sharded rounds; shards=1 BIT-parity with the
+# unsharded program; sharded execution parity to reassociation
+# tolerance), and (b) an end-to-end param-sharded run must hold under
+# tpu.recompile_guard with a stale cache + int8 EF residual riding the
+# sharded state.  After the gate, the bench_scaling --sharded cells
+# (including the >= 50M-param-per-node acceptance point) record the
+# per-device resident-params numbers into bench_scaling_sharded.json.
+if [ "$SHARDED" = 1 ]; then
+  echo "=== preflight: param-axis sharding (MUR1300-1303 + guarded run, CPU) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 1200 env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python - > "$OUT/preflight_sharded.out" 2>&1 <<'PYEOF'
+import sys
+
+from murmura_tpu.analysis.sharded import check_sharded
+
+findings = check_sharded()
+for f in findings:
+    print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+if findings:
+    print(f"FAIL: {len(findings)} MUR130x finding(s)")
+    sys.exit(1)
+print("MUR1300-1303 clean")
+
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_network_from_config
+
+cfg = Config.model_validate({
+    "experiment": {"name": "sharded-preflight", "seed": 3, "rounds": 6},
+    "topology": {"type": "ring", "num_nodes": 8},
+    "aggregation": {"algorithm": "krum",
+                    "params": {"num_compromised": 1}},
+    "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+    "data": {"adapter": "synthetic",
+             "params": {"num_samples": 64, "input_shape": [16],
+                        "num_classes": 4}},
+    "model": {"factory": "mlp",
+              "params": {"input_dim": 16, "hidden_dims": [36],
+                         "num_classes": 4}},
+    "backend": "tpu",
+    "faults": {"enabled": True, "straggler_prob": 0.3,
+               "link_drop_prob": 0.2, "seed": 11},
+    "exchange": {"max_staleness": 2, "staleness_discount": 0.5},
+    "compression": {"algorithm": "int8", "block": 8,
+                    "error_feedback": True},
+    "tpu": {"param_shards": 4, "param_dtype": "float32",
+            "compute_dtype": "float32", "recompile_guard": True},
+})
+net = build_network_from_config(cfg)
+h = net.train(rounds=6)
+print(f"guarded sharded run ok: mesh {dict(net.mesh.shape)}, "
+      f"flat_dim {net.program.flat_dim}, "
+      f"final acc {h['mean_accuracy'][-1]:.4f}")
+PYEOF
+  then
+    echo "preflight sharded FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_sharded.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight sharded clean" | tee -a "$OUT/battery.log"
+  run bench_scaling_sharded 7200 python bench_scaling.py --sharded --force
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

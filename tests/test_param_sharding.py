@@ -1,0 +1,501 @@
+"""Param-axis sharding (ISSUE 15): big per-node models on a
+("seed", "nodes", "param") mesh with ZeRO-style sharded aggregation.
+
+The contracts under test (docs/PERFORMANCE.md "Param-axis sharding"):
+
+- the padded flatteners are exact (zero pad, stripped on unravel) and
+  degenerate to the unpadded pair at shards=1;
+- ``make_param_mesh`` honors the request, falls back by largest dividing
+  factor, and refuses unfactorable layouts loudly;
+- a param-sharded round program matches the single-device program to
+  float-reassociation tolerance, while shards=1 is BIT-identical
+  (MUR1302);
+- every [N, P] carried-state tensor (stale cache, pipeline buffers, EF
+  residual) adopts the padded width and lands column-sharded on the mesh;
+- the int8 codec's block must divide the shard-local width (config-time
+  refusal), topk/dmtt/gang/population compositions are refused;
+- ``_p_chunk_len`` budgets shard-locally and never hands a chunk loop to
+  a program the scaled budget can hold (chunked loops degrade to column
+  gathers under GSPMD);
+- the pallas entry points refuse a sharded node axis, run shard-local
+  grids over a sharded param axis (parity-tested in interpret mode), and
+  fall back to lax otherwise;
+- a sharded run killed at a snapshot boundary resumes byte-identical,
+  and a snapshot written at one shard count refuses to restore into
+  another;
+- the MUR1300-1303 representative cells are clean.
+
+tests/conftest.py forces an 8-virtual-device CPU platform, so the
+(1, 2, 4) check mesh is always available here.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from murmura_tpu.config import Config
+from murmura_tpu.ops.flatten import (
+    make_flatteners,
+    make_sharded_flatteners,
+    padded_dim,
+)
+from murmura_tpu.parallel.mesh import (
+    active_param_shards,
+    make_param_mesh,
+    mesh_node_axis,
+    mesh_param_shards,
+    param_axis_scope,
+    plan_param_layout,
+    shard_step,
+)
+from murmura_tpu.utils.factories import (
+    ConfigError,
+    build_network_from_config,
+)
+
+
+def _raw(**over):
+    r = {
+        "experiment": {"name": "param-shard-test", "seed": 7, "rounds": 4},
+        "topology": {"type": "ring", "num_nodes": 8},
+        "aggregation": {"algorithm": "balance", "params": {}},
+        "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 40, "input_shape": [6],
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 6, "hidden_dims": [8],
+                             "num_classes": 3}},
+        "backend": "tpu",
+        "tpu": {"param_shards": 4, "param_dtype": "float32"},
+    }
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(r.get(k), dict):
+            r[k] = {**r[k], **v}
+        else:
+            r[k] = v
+    return r
+
+
+def _cfg(**over):
+    return Config.model_validate(_raw(**over))
+
+
+def _tiny_program(rule="krum", param_shards=1, **kw):
+    from jax.flatten_util import ravel_pytree
+
+    from murmura_tpu.aggregation import build_aggregator
+    from murmura_tpu.analysis.ir import AGG_CASES
+    from murmura_tpu.core.rounds import build_round_program
+    from murmura_tpu.data.base import FederatedArrays
+    from murmura_tpu.models import make_mlp
+
+    n, s = 8, 16
+    rng = np.random.default_rng(0)
+    data = FederatedArrays(
+        x=rng.normal(size=(n, s, 6)).astype(np.float32),
+        y=rng.integers(0, 3, size=(n, s)).astype(np.int32),
+        mask=np.ones((n, s), np.float32),
+        num_samples=np.full((n,), s),
+        num_classes=3,
+    )
+    model = make_mlp(input_dim=6, hidden_dims=(9,), num_classes=3)
+    dim = int(ravel_pytree(model.init(jax.random.PRNGKey(0)))[0].size)
+    agg = build_aggregator(
+        rule, dict(AGG_CASES.get(rule, {})),
+        model_dim=padded_dim(dim, param_shards), total_rounds=4,
+    )
+    return build_round_program(
+        model, agg, data, local_epochs=1, batch_size=8, lr=0.05,
+        total_rounds=4, seed=7, param_shards=param_shards, **kw,
+    )
+
+
+def _step_args(prog, adj=None):
+    n = prog.num_nodes
+    if adj is None:
+        adj = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
+    return (
+        prog.init_params,
+        {k: jnp.asarray(v) for k, v in prog.init_agg_state.items()},
+        jax.random.PRNGKey(0),
+        jnp.asarray(adj),
+        jnp.zeros((n,), jnp.float32),
+        jnp.asarray(0.0, jnp.float32),
+        {k: jnp.asarray(v) for k, v in prog.data_arrays.items()},
+    )
+
+
+# ---------------------------------------------------------------------------
+# Flatteners and mesh layout
+# ---------------------------------------------------------------------------
+
+
+class TestFlatteners:
+    def test_padded_roundtrip_and_zero_pad(self):
+        tmpl = {"w": np.arange(6, dtype=np.float32).reshape(2, 3),
+                "b": np.ones(5, np.float32)}
+        ravel, unravel, dim, flat = make_sharded_flatteners(tmpl, 4)
+        assert (dim, flat) == (11, 12)
+        v = np.asarray(ravel(tmpl))
+        assert v.shape == (12,) and v[11] == 0.0
+        back = unravel(jnp.asarray(v))
+        np.testing.assert_array_equal(np.asarray(back["w"]), tmpl["w"])
+        np.testing.assert_array_equal(np.asarray(back["b"]), tmpl["b"])
+
+    def test_shards1_degenerates_to_unpadded(self):
+        tmpl = {"b": np.ones(5, np.float32)}
+        r0, u0, d0 = make_flatteners(tmpl)
+        r1, u1, d1, f1 = make_sharded_flatteners(tmpl, 1)
+        assert d1 == f1 == d0 == 5
+        np.testing.assert_array_equal(
+            np.asarray(r1(tmpl)), np.asarray(r0(tmpl))
+        )
+
+    def test_padded_dim_validates(self):
+        assert padded_dim(11, 4) == 12
+        assert padded_dim(12, 4) == 12
+        with pytest.raises(ValueError):
+            padded_dim(3, 0)
+
+
+class TestParamMesh:
+    def test_primary_layout(self):
+        seed, nodes, param = plan_param_layout(8, 4, 8)
+        assert (seed, nodes, param) == (1, 2, 4)
+        mesh = make_param_mesh(8, 4)
+        assert mesh.axis_names == ("seed", "nodes", "param")
+        assert mesh_param_shards(mesh) == 4
+        assert mesh_node_axis(mesh) == 2
+
+    def test_largest_dividing_factor_fallback(self):
+        # 6 devices cannot give 4 param shards (4 does not divide 6):
+        # fall back to the largest divisor of the request that fits.
+        assert plan_param_layout(6, 4, 6) == (1, 3, 2)
+        # shards=1 degrades to the plain node layout.
+        assert plan_param_layout(8, 1, 8) == (1, 8, 1)
+
+    def test_unfactorable_raises(self):
+        with pytest.raises(ValueError, match="cannot lay"):
+            plan_param_layout(3, 5, 7)
+
+    def test_mesh_validates_program_shards(self):
+        prog = _tiny_program(param_shards=1)
+        mesh = make_param_mesh(prog.num_nodes, 4)
+        with pytest.raises(ValueError, match="param_shards"):
+            shard_step(prog.train_step, prog, mesh, donate=False)
+
+
+# ---------------------------------------------------------------------------
+# Program parity and state sharding
+# ---------------------------------------------------------------------------
+
+
+class TestShardedProgram:
+    def test_shards1_bit_parity(self):
+        # MUR1302's subject, gated per tier-1 run for one rule.
+        from murmura_tpu.analysis.sharded import bit_parity_findings
+
+        assert bit_parity_findings("krum") == []
+
+    def test_sharded_round_matches_single_device(self):
+        ref = _tiny_program(param_shards=1)
+        p_ref, _, _ = jax.jit(ref.train_step)(*_step_args(ref))
+        prog = _tiny_program(param_shards=4)
+        mesh = make_param_mesh(prog.num_nodes, 4)
+        step = shard_step(prog.train_step, prog, mesh, donate=False)
+        p_sh, _, m_sh = step(*_step_args(prog))
+        for a, b in zip(
+            jax.tree_util.tree_leaves(p_ref),
+            jax.tree_util.tree_leaves(p_sh),
+        ):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6
+            )
+
+    def test_carried_state_adopts_padded_width_and_shards(self):
+        from murmura_tpu.core.stale import CACHE_KEY, StalenessSpec
+        from murmura_tpu.faults.schedule import FaultSpec
+        from murmura_tpu.ops.compress import (
+            RESIDUAL_KEY,
+            CompressionSpec,
+        )
+
+        n = 8
+        base = np.ones((n, n), np.float32) - np.eye(n, dtype=np.float32)
+        prog = _tiny_program(
+            rule="fedavg", param_shards=4,
+            faults=FaultSpec(nan_quarantine=True),
+            staleness=StalenessSpec(max_staleness=2, base_mask=base),
+            compression=CompressionSpec(
+                algorithm="int8", block=1, error_feedback=True
+            ),
+        )
+        assert prog.flat_dim % 4 == 0 and prog.flat_dim >= prog.model_dim
+        for key in (CACHE_KEY, RESIDUAL_KEY):
+            assert prog.init_agg_state[key].shape == (n, prog.flat_dim)
+        mesh = make_param_mesh(n, 4)
+        step = shard_step(prog.train_step, prog, mesh, donate=False)
+        args = list(_step_args(prog))
+        args.insert(5, jnp.ones((n,), jnp.float32))  # alive mask
+        _, agg_state, _ = step(*args)
+        for key in (CACHE_KEY, RESIDUAL_KEY):
+            spec = agg_state[key].sharding.spec
+            assert "param" in str(spec), (key, spec)
+
+    def test_fused_dispatch_matches_per_round(self):
+        # The fused lax.scan path (shard_multi_round) rides the same
+        # param-aware spec builder as the per-round step; round keys are
+        # fold_in(base, r) on both, so histories must be byte-equal.
+        per_round = build_network_from_config(_cfg())
+        h1 = per_round.train(rounds=2)
+        fused = build_network_from_config(_cfg())
+        h2 = fused.train(rounds=2, rounds_per_dispatch=2)
+        assert h1["mean_accuracy"] == h2["mean_accuracy"]
+        assert h1["mean_loss"] == h2["mean_loss"]
+
+    def test_pipeline_buffer_adopts_padded_width(self):
+        from murmura_tpu.core.pipeline import BCAST_KEY, OWN_KEY
+
+        prog = _tiny_program(rule="fedavg", param_shards=4, pipeline=True)
+        n = prog.num_nodes
+        assert prog.init_agg_state[OWN_KEY].shape == (n, prog.flat_dim)
+        assert prog.init_agg_state[BCAST_KEY].shape == (n, prog.flat_dim)
+
+
+# ---------------------------------------------------------------------------
+# Mode rejections (config-time, loud)
+# ---------------------------------------------------------------------------
+
+
+class TestRejections:
+    def test_int8_block_straddle_rejected_at_build(self):
+        from murmura_tpu.ops.compress import CompressionSpec
+
+        # flat pad of the tiny MLP at 4 shards is 4-aligned; a block of
+        # 96 cannot divide the shard-local width.
+        with pytest.raises(ValueError, match="shard-local"):
+            _tiny_program(
+                rule="fedavg", param_shards=4,
+                compression=CompressionSpec(algorithm="int8", block=96),
+            )
+
+    def test_int8_block_straddle_rejected_by_factories(self):
+        with pytest.raises(ConfigError, match="shard-local"):
+            build_network_from_config(_cfg(
+                compression={"algorithm": "int8", "block": 96},
+            ))
+
+    def test_topk_rejected(self):
+        with pytest.raises(Exception, match="topk"):
+            _cfg(compression={"algorithm": "topk"})
+
+    def test_backend_simulation_rejected(self):
+        with pytest.raises(Exception, match="backend"):
+            _cfg(backend="simulation")
+
+    def test_sweep_rejected(self):
+        with pytest.raises(Exception, match="sweep"):
+            _cfg(sweep={"seeds": [1, 2]})
+
+    def test_gang_seeds_path_rejected(self):
+        # The CLI `run --seeds N` path bypasses the schema's sweep-block
+        # validator (sweep=None, explicit seed list) — the gang builder
+        # itself must refuse rather than silently drop the sharding.
+        from murmura_tpu.utils.factories import build_gang_from_config
+
+        with pytest.raises(ConfigError, match="unganged"):
+            build_gang_from_config(_cfg(), seeds=[7, 8])
+
+    def test_population_rejected(self):
+        with pytest.raises(Exception, match="population"):
+            _cfg(
+                topology={"type": "ring", "num_nodes": 8},
+                population={"enabled": True, "virtual_size": 64},
+            )
+
+
+# ---------------------------------------------------------------------------
+# Shard-local chunk budgeting and the pallas guard
+# ---------------------------------------------------------------------------
+
+
+class TestChunkBudget:
+    def test_scope_scales_budget_and_avoids_chunking(self):
+        from murmura_tpu.aggregation.base import (
+            _CIRCULANT_CHUNK_BYTES,
+            _p_chunk_len,
+        )
+
+        n = 1024
+        cap = _CIRCULANT_CHUNK_BYTES // (n * 4)
+        p = 4 * cap  # needs chunking unsharded, fits when 4-way sharded
+        assert _p_chunk_len(n, p, 4) == cap
+        mesh = make_param_mesh(8, 4)
+        with param_axis_scope(mesh, p):
+            assert active_param_shards(p) == 4
+            assert _p_chunk_len(n, p, 4) == p  # unchunked: budget x4
+            # Width the shard count does not divide: unsharded accounting.
+            assert active_param_shards(p + 1) == 1
+        assert active_param_shards(p) == 1  # scope closed
+
+    def test_still_chunked_case_aligns_to_shard_widths(self):
+        from murmura_tpu.aggregation.base import (
+            _CIRCULANT_CHUNK_BYTES,
+            _p_chunk_len,
+        )
+
+        n = 1024
+        cap = _CIRCULANT_CHUNK_BYTES // (n * 4)
+        p = 16 * cap  # too large even for the 4-way-scaled budget
+        mesh = make_param_mesh(8, 4)
+        with param_axis_scope(mesh, p):
+            chunk = _p_chunk_len(n, p, 4)
+            assert chunk < p and chunk % (p // 4) == 0
+
+
+class TestPallasGuard:
+    def _operands(self, p=256):
+        rng = np.random.default_rng(0)
+        own = jnp.asarray(rng.normal(size=(8, p)).astype(np.float32))
+        bcast = jnp.asarray(rng.normal(size=(8, p)).astype(np.float32))
+        return own, bcast
+
+    def test_sharded_nodes_refused(self):
+        from murmura_tpu.ops import pallas_agg
+
+        own, bcast = self._operands()
+        mesh = make_param_mesh(8, 1)  # (1, 8, 1): node axis sharded
+        assert mesh_node_axis(mesh) > 1
+        with param_axis_scope(mesh, 256):
+            assert pallas_agg.circulant_sq_distances(
+                own, bcast, (1, 2)
+            ) is None
+            assert pallas_agg.pairwise_sq_distances(own, bcast) is None
+            assert not pallas_agg.candidate_select_supported(
+                own, bcast, (1, 2)
+            )
+
+    def test_sharded_param_shard_local_parity(self):
+        from murmura_tpu.ops import pallas_agg
+
+        own, bcast = self._operands()
+        ref_circ = pallas_agg.circulant_sq_distances(own, bcast, (1, 2))
+        ref_pair = pallas_agg.pairwise_sq_distances(own, bcast)
+        ref_cand = pallas_agg.fused_candidate_select(
+            own, bcast, (1, 2, 3), median=True
+        )
+        devices = jax.devices()
+        from jax.sharding import Mesh
+
+        mesh = Mesh(
+            np.array(devices[:4]).reshape(1, 1, 4),
+            ("seed", "nodes", "param"),
+        )
+        with param_axis_scope(mesh, 256):
+            circ = pallas_agg.circulant_sq_distances(own, bcast, (1, 2))
+            pair = pallas_agg.pairwise_sq_distances(own, bcast)
+            cand = pallas_agg.fused_candidate_select(
+                own, bcast, (1, 2, 3), median=True
+            )
+        np.testing.assert_allclose(
+            np.asarray(circ), np.asarray(ref_circ), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(pair), np.asarray(ref_pair), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(cand), np.asarray(ref_cand)
+        )
+
+    def test_indivisible_width_falls_back_to_lax(self):
+        from murmura_tpu.ops import pallas_agg
+        from jax.sharding import Mesh
+
+        own, bcast = self._operands(p=255)  # 4 does not divide 255
+        mesh = Mesh(
+            np.array(jax.devices()[:4]).reshape(1, 1, 4),
+            ("seed", "nodes", "param"),
+        )
+        with param_axis_scope(mesh, 255):
+            assert pallas_agg.circulant_sq_distances(
+                own, bcast, (1, 2)
+            ) is None
+            assert not pallas_agg.candidate_select_supported(
+                own, bcast, (1, 2)
+            )
+
+
+# ---------------------------------------------------------------------------
+# Durability: sharded SIGKILL-resume and the shard-count refusal
+# ---------------------------------------------------------------------------
+
+
+class TestShardedDurability:
+    def test_sigkill_at_snapshot_boundary_resumes_byte_identical(
+        self, tmp_path
+    ):
+        from tests.test_durability import _assert_same_run
+
+        full = build_network_from_config(_cfg())
+        full.train(rounds=2)
+        full.save_checkpoint(str(tmp_path / "snap"))
+        full.train(rounds=2)
+
+        resumed = build_network_from_config(_cfg())
+        assert resumed.restore_checkpoint(str(tmp_path / "snap")) == 2
+        resumed.train(rounds=2)
+        _assert_same_run(full, resumed, "sharded@r2")
+
+    def test_restore_refuses_shard_count_mismatch(self, tmp_path):
+        writer = build_network_from_config(_cfg())  # param_shards=4
+        writer.train(rounds=1)
+        writer.save_checkpoint(str(tmp_path / "snap4"))
+        reader = build_network_from_config(
+            _cfg(tpu={"param_shards": 2, "param_dtype": "float32"})
+        )
+        with pytest.raises(ValueError, match="param_shards"):
+            reader.restore_checkpoint(str(tmp_path / "snap4"))
+
+    def test_unsharded_refuses_sharded_snapshot(self, tmp_path):
+        writer = build_network_from_config(_cfg())
+        writer.train(rounds=1)
+        writer.save_checkpoint(str(tmp_path / "snap4"))
+        reader = build_network_from_config(
+            _cfg(tpu={"param_shards": 1, "param_dtype": "float32"})
+        )
+        with pytest.raises(ValueError, match="param_shards"):
+            reader.restore_checkpoint(str(tmp_path / "snap4"))
+
+
+# ---------------------------------------------------------------------------
+# MUR1300-1303 gates
+# ---------------------------------------------------------------------------
+
+
+class TestShardedChecks:
+    def test_mur1300_1303_representative_cell(self):
+        from murmura_tpu.analysis.sharded import inventory_cell_findings
+
+        assert inventory_cell_findings("krum", "circulant") == []
+
+    def test_mur1301_representative_cell(self):
+        from murmura_tpu.analysis.sharded import recompile_cell_findings
+
+        assert recompile_cell_findings("fedavg", "dense") == []
+
+    def test_oversized_all_reduce_parser_fires(self):
+        from murmura_tpu.analysis.sharded import oversized_all_reduces
+
+        hlo = (
+            "%ar = f32[8,2048]{1,0} all-reduce(f32[8,2048]{1,0} %x)\n"
+            "%ok = f32[8,8]{1,0} all-reduce(f32[8,8]{1,0} %y)\n"
+        )
+        assert oversized_all_reduces(hlo, 1024) == [8 * 2048]
+
+    @pytest.mark.slow
+    def test_full_sharded_check_clean(self):
+        from murmura_tpu.analysis.sharded import check_sharded
+
+        assert check_sharded() == []

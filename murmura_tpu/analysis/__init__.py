@@ -45,6 +45,7 @@ def run_check_detailed(
     staleness: Optional[bool] = None,
     pipeline: Optional[bool] = None,
     sharded: Optional[bool] = None,
+    compose: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -74,18 +75,27 @@ def run_check_detailed(
     (analysis/sharded.py, MUR1300-1303: sharded-P collective
     inventory — ppermute-only on "nodes", one small psum over "param"
     — zero recompiles across sharded rounds, shards=1 bit-parity with
-    the unsharded program, and sharded execution parity).
+    the unsharded program, and sharded execution parity), and when
+    ``compose`` is enabled the cross-feature composition grid
+    (analysis/composition.py, MUR1400-1403: lever-manifest/guard
+    bijection with the executable refusal census, the generated
+    pairwise grid over every declared-compatible pair — recompile-free
+    composed builds with collective-inventory parity — composed
+    carried-state/stage-order parity, and flow-taint preservation on
+    composed cells).
     ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
-    ``staleness=None``/``pipeline=None``/``sharded=None`` mean "on for
-    the package check, off for explicit paths" (all seven passes are
-    package-global: they exercise the live registry, not the files
-    named on the command line).
+    ``staleness=None``/``pipeline=None``/``sharded=None``/
+    ``compose=None`` mean "on for the package check, off for explicit
+    paths" (all eight passes are package-global: they exercise the live
+    registry, not the files named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
     grid cell (measured vs committed flops/bytes, including in-tolerance
     cells) and one ``{"kind": "flow_summary", ...}`` per (rule, exchange
-    mode) flow cell with its per-node taint-set payload.
+    mode) flow cell with its per-node taint-set payload, plus one
+    ``{"kind": "compose_summary", ...}`` per composition-grid pair with
+    its verdict, cell kind and recompile count.
     """
     run_ir = ir if ir is not None else not paths
     run_flow = flow if flow is not None else not paths
@@ -94,6 +104,7 @@ def run_check_detailed(
     run_staleness = staleness if staleness is not None else not paths
     run_pipeline = pipeline if pipeline is not None else not paths
     run_sharded = sharded if sharded is not None else not paths
+    run_compose = compose if compose is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -133,6 +144,11 @@ def run_check_detailed(
         from murmura_tpu.analysis import sharded as sharded_mod
 
         findings.extend(sharded_mod.check_sharded())
+    if run_compose:
+        from murmura_tpu.analysis import composition as composition_mod
+
+        findings.extend(composition_mod.check_composition())
+        records.extend(composition_mod.compose_summaries())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -147,13 +163,14 @@ def run_check(
     staleness: Optional[bool] = None,
     pipeline: Optional[bool] = None,
     sharded: Optional[bool] = None,
+    compose: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
         adaptive=adaptive, staleness=staleness, pipeline=pipeline,
-        sharded=sharded,
+        sharded=sharded, compose=compose,
     )[0]
 
 
@@ -170,9 +187,10 @@ def format_findings_json(
 ) -> str:
     """JSON-lines rendering for editors/CI (``check --json``): one
     ``{"kind": "finding", ...}`` object per finding followed by the
-    non-finding records — ``budget_delta`` rows per cost grid cell and
+    non-finding records — ``budget_delta`` rows per cost grid cell,
     ``flow_summary`` rows per (rule, exchange mode) flow cell (their
-    per-rule taint-set payloads ride ``data``/``taint_sets``).  Legacy
+    per-rule taint-set payloads ride ``data``/``taint_sets``) and
+    ``compose_summary`` rows per composition-grid pair.  Legacy
     callers may still pass bare budget-delta dicts; they default to
     ``kind: budget_delta``."""
     lines = [

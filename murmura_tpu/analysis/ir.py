@@ -128,7 +128,7 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 _CHECK_ENTRY_POINTS = frozenset(
     {"check_ir", "check_coverage", "check_flow", "check_durability",
      "check_adaptive", "check_staleness", "check_pipeline",
-     "check_sharded"}
+     "check_sharded", "check_composition"}
 )
 
 
@@ -1681,6 +1681,13 @@ def check_coverage() -> List[Finding]:
     findings.extend(
         _unwired_family_findings(
             sharded_mod, sharded_mod.SHARDED_CHECK_FAMILIES
+        )
+    )
+    from murmura_tpu.analysis import composition as composition_mod
+
+    findings.extend(
+        _unwired_family_findings(
+            composition_mod, composition_mod.COMPOSE_CHECK_FAMILIES
         )
     )
     return findings

@@ -130,6 +130,18 @@ RULES: Dict[str, str] = {
     "MUR1201": "pipeline-recompile",
     "MUR1202": "pipeline-collective-inventory",
     "MUR1203": "pipeline-delayed-influence",
+    # 13xx = param-axis sharding contracts (analysis/sharded.py;
+    # docs/PERFORMANCE.md "Param-axis sharding")
+    "MUR1300": "sharded-collective-inventory",
+    "MUR1301": "sharded-recompile",
+    "MUR1302": "sharded-bit-parity",
+    "MUR1303": "sharded-execution-parity",
+    # 14xx = cross-feature composition contracts (analysis/composition.py,
+    # `check --compose`; docs/ANALYSIS.md "Composition grid")
+    "MUR1400": "manifest-bijection",
+    "MUR1401": "composition-grid",
+    "MUR1402": "composition-state-stages",
+    "MUR1403": "composition-influence",
 }
 
 

@@ -521,3 +521,23 @@ ADAPTIVE_ATTACKS: Dict[str, Callable[[], AdaptiveAttack]] = {
     ),
     "bisection": _probe_bisection,
 }
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="adaptive",
+    module="murmura_tpu.attacks.adaptive",
+    state_keys_group="ATTACK_STATE_KEYS",
+    stage="murmura.exchange",
+    # First lever alphabetically: every pair it belongs to is declared
+    # by the later peer (levers.py declaration convention).
+    verdicts={},
+)

@@ -669,10 +669,22 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "explicit PATHS are given.",
 )
 @click.option(
+    "--compose/--no-compose", "compose", default=None,
+    help="Run the cross-feature composition grid (MUR1400-1403: lever-"
+         "manifest/guard bijection with the executable refusal census, "
+         "the generated pairwise grid — every declared-compatible pair "
+         "builds, trains recompile-free and keeps collective-inventory "
+         "parity — composed carried-state/stage-order parity, and "
+         "flow-taint preservation on composed cells).  Compiles and "
+         "runs one tiny composed program per compatible pair (~3 min "
+         "on CPU).  Default: on for the package check, off when "
+         "explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
-    help="Emit findings (and budget-delta / flow-summary records) as JSON "
-         "lines for editor/CI annotation instead of the greppable text "
-         "format.",
+    help="Emit findings (and budget-delta / flow-summary / "
+         "compose-summary records) as JSON lines for editor/CI "
+         "annotation instead of the greppable text format.",
 )
 @click.option(
     "--update-budgets", is_flag=True, default=False,
@@ -680,7 +692,7 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "review the diff as perf history.",
 )
 def check(paths, contracts, ir, flow, durability, adaptive, staleness,
-          pipeline, sharded, as_json, update_budgets):
+          pipeline, sharded, compose, as_json, update_budgets):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -694,8 +706,9 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     --contracts; MUR901/902 resume determinism via --durability), the
     adaptive-adversary contracts (MUR1000-1003 via --adaptive), the
     bounded-staleness contracts (MUR1100-1103 via --staleness), the
-    pipelined-rounds contracts (MUR1200-1203 via --pipeline), and the
-    param-axis sharding contracts (MUR1300-1303 via --sharded).
+    pipelined-rounds contracts (MUR1200-1203 via --pipeline), the
+    param-axis sharding contracts (MUR1300-1303 via --sharded), and the
+    cross-feature composition grid (MUR1400-1403 via --compose).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -718,7 +731,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     findings, records = run_check_detailed(
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
         durability=durability, adaptive=adaptive, staleness=staleness,
-        pipeline=pipeline, sharded=sharded,
+        pipeline=pipeline, sharded=sharded, compose=compose,
     )
     if as_json:
         out = format_findings_json(findings, records)

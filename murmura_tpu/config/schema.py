@@ -11,6 +11,8 @@ from typing import Any, Dict, List, Literal, Optional
 
 from pydantic import BaseModel, ConfigDict, Field, model_validator
 
+from murmura_tpu.levers import refusal_reason
+
 
 class _Strict(BaseModel):
     model_config = ConfigDict(extra="forbid")
@@ -1110,11 +1112,7 @@ class Config(_Strict):
                 "per-node OS processes — use backend: simulation or tpu"
             )
         if self.dmtt is not None:
-            raise ValueError(
-                "adaptive attacks do not compose with dmtt (the claims "
-                "channel is a second feedback path the adaptation state "
-                "does not model)"
-            )
+            raise ValueError(refusal_reason("adaptive", "dmtt"))
         return self
 
     @model_validator(mode="after")
@@ -1196,16 +1194,9 @@ class Config(_Strict):
                 "backend: simulation or tpu"
             )
         if self.mobility is not None:
-            raise ValueError(
-                "sparse topologies do not compose with mobility (G^t is a "
-                "dense per-round graph); drop the mobility block or use a "
-                "dense topology"
-            )
+            raise ValueError(refusal_reason("mobility", "sparse"))
         if self.dmtt is not None:
-            raise ValueError(
-                "sparse topologies do not compose with dmtt (claim "
-                "verification needs the dense exchange graph)"
-            )
+            raise ValueError(refusal_reason("dmtt", "sparse"))
         return self
 
     @model_validator(mode="after")
@@ -1243,15 +1234,9 @@ class Config(_Strict):
                 "in per-node OS processes — use backend: simulation or tpu"
             )
         if self.sweep is not None:
-            raise ValueError(
-                "population does not compose with sweep (gang batching) "
-                "yet — run cohort-streaming experiments unganged"
-            )
+            raise ValueError(refusal_reason("population", "sweep"))
         if self.dmtt is not None:
-            raise ValueError(
-                "population does not compose with dmtt (trust state is "
-                "keyed by node identity, which cohort swaps reassign)"
-            )
+            raise ValueError(refusal_reason("dmtt", "population"))
         return self
 
     @model_validator(mode="after")
@@ -1274,10 +1259,7 @@ class Config(_Strict):
                 "backend: simulation or tpu"
             )
         if self.dmtt is not None:
-            raise ValueError(
-                "compression does not compose with dmtt (claim "
-                "cross-evaluation consumes the uncompressed broadcast)"
-            )
+            raise ValueError(refusal_reason("compression", "dmtt"))
         if self.population is not None and self.population.enabled:
             if c.error_feedback or c.algorithm == "topk":
                 # Both the error-feedback residual and the topk reference
@@ -1285,10 +1267,7 @@ class Config(_Strict):
                 # slots to different users, so the carried state would be
                 # fed into the wrong user's stream.  Stateless int8 is fine.
                 raise ValueError(
-                    "compression with carried state (error_feedback, or "
-                    "algorithm: topk) does not compose with population "
-                    "(cohort swaps reassign node slots); use stateless "
-                    "int8 or disable the population block"
+                    refusal_reason("compression", "population", "carried_state")
                 )
         return self
 
@@ -1309,9 +1288,7 @@ class Config(_Strict):
             return self
         if not self.faults.enabled:
             raise ValueError(
-                "exchange.max_staleness requires faults.enabled: true — "
-                "without the fault model nothing ever misses a round, so "
-                "the stale cache would be dead state in every program"
+                refusal_reason("faults", "staleness", "requires_faults")
             )
         if self.backend == "distributed":
             raise ValueError(
@@ -1321,34 +1298,15 @@ class Config(_Strict):
                 "simulation or tpu"
             )
         if self.dmtt is not None:
-            raise ValueError(
-                "bounded staleness does not compose with dmtt (the "
-                "exchange graph is trust-gated per round; a cached row "
-                "would bypass the round's claim verification)"
-            )
+            raise ValueError(refusal_reason("dmtt", "staleness"))
         if self.mobility is not None:
-            raise ValueError(
-                "bounded staleness does not compose with mobility: an "
-                "edge leaving G^t is topology change, not a fault, and "
-                "the re-add layer needs a static base graph baked at "
-                "trace time"
-            )
+            raise ValueError(refusal_reason("mobility", "staleness"))
         if self.topology.type == "one_peer":
             raise ValueError(
-                "bounded staleness does not compose with the one_peer "
-                "topology (its active offset varies per round as mask "
-                "values, so there is no static base edge mask to re-add "
-                "from); use the exponential sparse family or a dense "
-                "topology"
+                refusal_reason("sparse", "staleness", "one_peer")
             )
         if self.population is not None and self.population.enabled:
-            raise ValueError(
-                "bounded staleness does not compose with population "
-                "(the payload cache is per-slot [N, P] carried state; "
-                "cohort swaps reassign node slots, so a cached row would "
-                "be served into the wrong user's stream — the "
-                "compression carried-state rationale)"
-            )
+            raise ValueError(refusal_reason("population", "staleness"))
         return self
 
     @model_validator(mode="after")
@@ -1363,28 +1321,11 @@ class Config(_Strict):
                 "ZMQ per round — use backend: simulation or tpu"
             )
         if self.dmtt is not None:
-            raise ValueError(
-                "exchange.pipeline does not compose with dmtt (claim "
-                "verification gates each round's exchange between "
-                "production and aggregation; delaying the aggregation "
-                "would verify claims against a different round's graph)"
-            )
+            raise ValueError(refusal_reason("dmtt", "pipeline"))
         if self.attack.adaptive.enabled:
-            raise ValueError(
-                "exchange.pipeline does not compose with "
-                "attack.adaptive: the acceptance feedback would observe "
-                "round r-1's aggregation after round r's production "
-                "already ran, changing the closed loop's timing "
-                "semantics — run adaptive experiments serialized"
-            )
+            raise ValueError(refusal_reason("adaptive", "pipeline"))
         if self.population is not None and self.population.enabled:
-            raise ValueError(
-                "exchange.pipeline does not compose with population "
-                "(the pipeline buffer is per-slot [N, P] carried state; "
-                "cohort swaps reassign node slots, so a buffered row "
-                "would be aggregated into the wrong user's stream — the "
-                "compression/staleness carried-state rationale)"
-            )
+            raise ValueError(refusal_reason("pipeline", "population"))
         return self
 
     @model_validator(mode="after")
@@ -1399,33 +1340,16 @@ class Config(_Strict):
                 "to shard over"
             )
         if self.dmtt is not None:
-            raise ValueError(
-                "tpu.param_shards does not compose with dmtt (the N x N "
-                "claim cross-evaluation unravels every broadcast row into "
-                "a full model per pair — there is no sharded formulation "
-                "of that sweep)"
-            )
+            raise ValueError(refusal_reason("dmtt", "sharding"))
         if self.compression.algorithm == "topk":
             raise ValueError(
-                "tpu.param_shards does not compose with compression."
-                "algorithm: topk (the per-row global top-k needs the full "
-                "[P] row resident on one device, defeating the shard); "
-                "use the int8 codec — its per-block scales shard with P"
+                refusal_reason("compression", "sharding", "topk")
             )
-        if self.sweep is not None:
-            raise ValueError(
-                "tpu.param_shards does not compose with sweep (gang "
-                "batching) yet — the gang's [S, N, P] stacked state would "
-                "need a fourth mesh role; run param-sharded experiments "
-                "unganged"
-            )
+        # sweep x sharding LIFTED (ISSUE 16): the gang mesh grew a
+        # "param" role — make_gang_param_mesh lays ("seed", "nodes",
+        # "param") and the [S, N, P] stacked state shards on it.
         if self.population is not None and self.population.enabled:
-            raise ValueError(
-                "tpu.param_shards does not compose with population yet "
-                "(the memmapped user bank stages full [P] rows per cohort "
-                "swap; a sharded bank is ROADMAP item 5's sharded-bank "
-                "leg)"
-            )
+            raise ValueError(refusal_reason("population", "sharding"))
         return self
 
     @model_validator(mode="after")
@@ -1457,8 +1381,6 @@ class Config(_Strict):
     def _dmtt_requires_mobility(self):
         if self.dmtt is not None and self.mobility is None and not self.dmtt.allow_static:
             raise ValueError(
-                "dmtt requires a mobility section (claim verification needs "
-                "the deterministic G^t); set dmtt.allow_static: true to "
-                "verify claims against the static topology instead"
+                refusal_reason("dmtt", "mobility", "requires_mobility")
             )
         return self

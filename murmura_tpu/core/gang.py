@@ -376,12 +376,21 @@ class GangNetwork:
                 gang_adj_stack_sharding,
                 gang_node_sharding,
                 make_gang_mesh,
+                make_gang_param_mesh,
                 shard_gang_eval_step,
                 shard_gang_step,
             )
 
             if mesh is None:
-                mesh = make_gang_mesh(self.batch, n, num_devices)
+                if getattr(program, "param_shards", 1) > 1:
+                    # The sharding x sweep lift (ISSUE 16): the gang
+                    # mesh grows a "param" role so the [S, N, P] stacked
+                    # state shards its trailing flat axis too.
+                    mesh = make_gang_param_mesh(
+                        self.batch, n, program.param_shards, num_devices
+                    )
+                else:
+                    mesh = make_gang_mesh(self.batch, n, num_devices)
             self.mesh = mesh
             self._step = shard_gang_step(
                 vstep, program, self.batch, mesh, donate=donate
@@ -430,10 +439,18 @@ class GangNetwork:
         single host) — the gang twin of Network._place_resident_state."""
         if self.mesh is None or jax.process_count() > 1:
             return
-        from murmura_tpu.parallel.mesh import _shard_gang_leading
+        from murmura_tpu.parallel.mesh import (
+            _shard_gang_leading,
+            mesh_param_shards,
+        )
 
+        flat_dim = None
+        if mesh_param_shards(self.mesh) > 1:
+            flat_dim = getattr(
+                self.program, "flat_dim", self.program.model_dim
+            )
         place = lambda tree: jax.device_put(  # noqa: E731
-            tree, _shard_gang_leading(tree, self.mesh)
+            tree, _shard_gang_leading(tree, self.mesh, flat_dim)
         )
         self.params = place(self.params)
         self.agg_state = place(self.agg_state)
@@ -931,3 +948,45 @@ class GangNetwork:
             i: {k: float(v[i]) for k, v in self._last_stats[member].items()}
             for i in range(n)
         }
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="sweep",
+    module="murmura_tpu.core.gang",
+    mesh_axes=("seed",),
+    verdicts={
+        "adaptive": composes(),
+        "compression": composes(),
+        "dmtt": composes(),
+        "faults": composes(),
+        "mobility": composes(),
+        "pipeline": composes(),
+        "population": refuses(
+            "population does not compose with sweep (gang batching) "
+            "yet — run cohort-streaming experiments unganged"
+        ),
+        # Lifted (ISSUE 16): the gang mesh grew a "param" role —
+        # make_gang_param_mesh lays ("seed", "nodes", "param") and the
+        # [S, N, P] stacked state shards on its trailing axis.
+        "sharding": composes(),
+        "sparse": composes(
+            tpu_backend=(
+                "sparse topologies (exponential/one_peer) are not "
+                "gang-batchable on backend: tpu yet (the gang mesh "
+                "lacks the [k, N] edge-mask sharding layout) — use "
+                "backend: simulation for sparse gangs, or run sparse "
+                "tpu experiments unganged"
+            ),
+        ),
+        "staleness": composes(),
+    },
+)

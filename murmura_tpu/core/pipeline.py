@@ -233,3 +233,38 @@ def run_delayed_reference(
                 prog.evidential, net.attack is not None,
             )
     return params, history
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="pipeline",
+    module="murmura_tpu.core.pipeline",
+    state_keys_group="PIPELINE_STATE_KEYS",
+    stage="murmura.pipeline",
+    verdicts={
+        "adaptive": refuses(
+            "exchange.pipeline does not compose with attack.adaptive: "
+            "the acceptance feedback would observe round r-1's "
+            "aggregation after round r's production already ran, "
+            "changing the closed loop's timing semantics — run "
+            "adaptive experiments serialized"
+        ),
+        "compression": composes(),
+        "dmtt": refuses(
+            "exchange.pipeline does not compose with dmtt (claim "
+            "verification gates each round's exchange between "
+            "production and aggregation; delaying the aggregation "
+            "would verify claims against a different round's graph)"
+        ),
+        "faults": composes(),
+        "mobility": composes(),
+    },
+)

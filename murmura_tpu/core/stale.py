@@ -319,3 +319,63 @@ def make_stale_fold(
         return bcast_eff, adj_eff, updates, stats
 
     return fold
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="staleness",
+    module="murmura_tpu.core.stale",
+    state_keys_group="STALE_STATE_KEYS",
+    stage="murmura.stale",
+    verdicts={
+        "adaptive": composes(),
+        "compression": composes(),
+        "dmtt": refuses(
+            "bounded staleness does not compose with dmtt (the "
+            "exchange graph is trust-gated per round; a cached row "
+            "would bypass the round's claim verification)"
+        ),
+        # Staleness is DEFINED over the fault model: without it the
+        # cache is dead state, so the dependency is a constraint tag.
+        "faults": composes(
+            requires_faults=(
+                "exchange.max_staleness requires faults.enabled: true "
+                "— without the fault model nothing ever misses a "
+                "round, so the stale cache would be dead state in "
+                "every program"
+            ),
+        ),
+        "mobility": refuses(
+            "bounded staleness does not compose with mobility: an "
+            "edge leaving G^t is topology change, not a fault, and "
+            "the re-add layer needs a static base graph baked at "
+            "trace time"
+        ),
+        "pipeline": composes(),
+        "population": refuses(
+            "bounded staleness does not compose with population "
+            "(the payload cache is per-slot [N, P] carried state; "
+            "cohort swaps reassign node slots, so a cached row would "
+            "be served into the wrong user's stream — the "
+            "compression carried-state rationale)"
+        ),
+        "sharding": composes(),
+        "sparse": composes(
+            one_peer=(
+                "bounded staleness does not compose with the one_peer "
+                "topology (its active offset varies per round as mask "
+                "values, so there is no static base edge mask to "
+                "re-add from); use the exponential sparse family or a "
+                "dense topology"
+            ),
+        ),
+    },
+)

@@ -207,3 +207,33 @@ def dmtt_round_update(
         / jnp.maximum(candidates.sum(axis=1), 1.0),
     }
     return ack, new_state, stats
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="dmtt",
+    module="murmura_tpu.dmtt.protocol",
+    # DMTT_STATE_KEYS lives in core/rounds.py (the program owns the
+    # trust carry); the group name is what MUR1400 resolves.
+    state_keys_group="DMTT_STATE_KEYS",
+    stage="murmura.exchange",
+    verdicts={
+        "adaptive": refuses(
+            "adaptive attacks do not compose with dmtt (the claims "
+            "channel is a second feedback path the adaptation state "
+            "does not model)"
+        ),
+        "compression": refuses(
+            "compression does not compose with dmtt (claim "
+            "cross-evaluation consumes the uncompressed broadcast)"
+        ),
+    },
+)

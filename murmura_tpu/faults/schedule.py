@@ -276,3 +276,25 @@ class FaultSchedule:
         if round_idx == 0:
             return np.zeros(self.num_nodes, dtype=bool)
         return (self._alive[round_idx - 1] <= 0) & (self._alive[round_idx] > 0)
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="faults",
+    module="murmura_tpu.faults.schedule",
+    verdicts={
+        # The fault mask is an input every program variant consumes;
+        # attacks, codecs and claims all see the thinned graph.
+        "adaptive": composes(),
+        "compression": composes(),
+        "dmtt": composes(),
+    },
+)

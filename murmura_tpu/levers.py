@@ -1,0 +1,285 @@
+"""Lever manifests: the declared cross-feature composition grid.
+
+The framework's orthogonal levers (gang sweep, population streaming,
+param-axis sharding, compressed exchange, bounded staleness, pipelined
+rounds, adaptive attacks, fault schedules, sparse topologies, mobility,
+DMTT) interact through a web of ``ConfigError`` refusals in
+``config/schema.py`` and ``utils/factories.py``.  Historically each
+refusal was hand-written at its guard site; this module makes every
+lever declare its composition surface EXACTLY ONCE:
+
+- the reserved ``*_STATE_KEYS`` group it rides in ``agg_state`` (if any),
+- its mesh-axis placement ("seed" / "nodes" / "param"),
+- its ``jax.named_scope`` stage hook in the round program (if any),
+- an explicit per-peer verdict: ``composes()`` | ``refuses(reason)``,
+  with constrained composition expressed as ``composes(tag=reason)``
+  (the pair composes EXCEPT under the tagged sub-configuration).
+
+Guard sites cite ``refusal_reason(a, b)`` instead of a literal string,
+so the message a user sees and the verdict an analyzer checks are the
+same object — `murmura check --compose` (analysis/composition.py,
+MUR1400-1403) verifies the bijection both ways: every guard resolves to
+a declared verdict, every declared refusal has a live guard, and every
+declared-compatible pair's composed round program actually composes
+(zero recompiles, collective-inventory parity, flow-taint preservation).
+
+Declaration convention: for each unordered pair the alphabetically
+LATER lever declares the verdict about the EARLIER peer, so the grid
+has exactly one owner per pair and ``lever_manifests()`` can check
+coverage is total.  Each manifest lives as a module-level
+``LEVER_MANIFEST`` in the lever's home module (next to its
+``*_STATE_KEYS`` tuple where one exists) and is AST-discoverable the
+same way ``durability/snapshot.py`` discovers state-key groups.
+
+This module imports nothing from the package at import time (lever
+modules import it at module level; manifests are pulled lazily).
+"""
+from __future__ import annotations
+
+import ast
+import importlib
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# Verdicts
+# ---------------------------------------------------------------------------
+
+COMPOSES = "composes"
+REFUSES = "refuses"
+
+
+@dataclass(frozen=True)
+class Verdict:
+    """One lever's declared compatibility with one peer.
+
+    ``kind`` is ``"composes"`` or ``"refuses"``.  A refusal carries the
+    user-facing ``reason`` verbatim (guard sites raise it unchanged).  A
+    constrained composition carries ``constraints``: (tag, reason) pairs
+    for the sub-configurations that DO refuse — e.g. staleness composes
+    with sparse topologies except ``one_peer``.
+    """
+
+    kind: str
+    reason: Optional[str] = None
+    constraints: Tuple[Tuple[str, str], ...] = ()
+
+    def __post_init__(self):
+        if self.kind not in (COMPOSES, REFUSES):
+            raise ValueError(f"verdict kind must be composes|refuses: {self.kind!r}")
+        if self.kind == REFUSES and not self.reason:
+            raise ValueError("refuses() verdicts need a reason")
+        if self.kind == COMPOSES and self.reason is not None:
+            raise ValueError("composes() verdicts carry constraints, not a reason")
+
+
+def composes(**constraints: str) -> Verdict:
+    """The pair composes; keyword args declare refused sub-configs."""
+    return Verdict(COMPOSES, None, tuple(sorted(constraints.items())))
+
+
+def refuses(reason: str) -> Verdict:
+    """The pair refuses outright; ``reason`` is the guard's message."""
+    return Verdict(REFUSES, reason)
+
+
+@dataclass(frozen=True)
+class LeverManifest:
+    """One lever's single-source composition declaration."""
+
+    name: str                         # grid name, e.g. "staleness"
+    module: str                       # home module (where this lives)
+    state_keys_group: Optional[str] = None   # reserved *_STATE_KEYS name
+    mesh_axes: Tuple[str, ...] = ()   # mesh roles it occupies
+    stage: Optional[str] = None       # named_scope hook in the round program
+    verdicts: Dict[str, Verdict] = field(default_factory=dict)
+
+    def __post_init__(self):
+        for peer, v in self.verdicts.items():
+            if peer >= self.name:
+                raise ValueError(
+                    f"lever '{self.name}' declares a verdict for "
+                    f"'{peer}' — the alphabetically later lever owns "
+                    "each pair's verdict, so only earlier peers belong "
+                    "here"
+                )
+            if not isinstance(v, Verdict):
+                raise ValueError(
+                    f"lever '{self.name}' verdict for '{peer}' is not a "
+                    "Verdict (use composes()/refuses())"
+                )
+
+
+# ---------------------------------------------------------------------------
+# Registry: lever name -> home module
+# ---------------------------------------------------------------------------
+
+# Every orthogonal lever and the module that owns its LEVER_MANIFEST.
+# analysis/composition.py MUR1400 checks this table against an AST scan
+# of the package (the MUR900 discovery pattern), so a manifest added
+# without a registry row — or a row whose module lost its manifest — is
+# a finding, not a silent gap.
+LEVER_MODULES: Dict[str, str] = {
+    "adaptive": "murmura_tpu.attacks.adaptive",
+    "compression": "murmura_tpu.ops.compress",
+    "dmtt": "murmura_tpu.dmtt.protocol",
+    "faults": "murmura_tpu.faults.schedule",
+    "mobility": "murmura_tpu.topology.dynamic",
+    "pipeline": "murmura_tpu.core.pipeline",
+    "population": "murmura_tpu.population.engine",
+    "sharding": "murmura_tpu.parallel.mesh",
+    "sparse": "murmura_tpu.topology.sparse",
+    "staleness": "murmura_tpu.core.stale",
+    "sweep": "murmura_tpu.core.gang",
+}
+
+# The round program's named_scope stage labels in execution order
+# (core/rounds.py) — MUR1402 checks each manifest's ``stage`` against
+# the traced first-occurrence order, so this list and the jaxpr agree.
+STAGE_ORDER: Tuple[str, ...] = (
+    "murmura.train",
+    "murmura.exchange",
+    "murmura.compress",
+    "murmura.stale",
+    "murmura.aggregate",
+    "murmura.pipeline",
+    "murmura.eval",
+)
+
+
+_MANIFEST_MEMO: Optional[Dict[str, LeverManifest]] = None
+
+
+def lever_manifests(force: bool = False) -> Dict[str, LeverManifest]:
+    """Import every lever module and collect its ``LEVER_MANIFEST``.
+
+    Fails loudly (KeyError/ValueError) on a missing manifest, a name
+    mismatch, or incomplete pair coverage — a manifest that cannot be
+    loaded is a bug in the declaration layer itself, not a finding.
+    """
+    global _MANIFEST_MEMO
+    if _MANIFEST_MEMO is not None and not force:
+        return _MANIFEST_MEMO
+    manifests: Dict[str, LeverManifest] = {}
+    for name, modname in LEVER_MODULES.items():
+        mod = importlib.import_module(modname)
+        manifest = getattr(mod, "LEVER_MANIFEST", None)
+        if manifest is None:
+            raise ValueError(
+                f"lever module {modname} has no LEVER_MANIFEST "
+                f"(declared in LEVER_MODULES as lever '{name}')"
+            )
+        if manifest.name != name or manifest.module != modname:
+            raise ValueError(
+                f"LEVER_MANIFEST in {modname} declares "
+                f"name={manifest.name!r} module={manifest.module!r}; the "
+                f"LEVER_MODULES registry says ({name!r}, {modname!r})"
+            )
+        manifests[name] = manifest
+    # Coverage: the later lever of every unordered pair declares it.
+    names = sorted(manifests)
+    for j, later in enumerate(names):
+        declared = set(manifests[later].verdicts)
+        expected = set(names[:j])
+        missing = expected - declared
+        extra = declared - expected
+        if missing or extra:
+            raise ValueError(
+                f"lever '{later}' verdict coverage is not total: "
+                f"missing={sorted(missing)} unknown={sorted(extra)}"
+            )
+    _MANIFEST_MEMO = manifests
+    return manifests
+
+
+def pair_verdict(a: str, b: str) -> Verdict:
+    """The declared verdict for the unordered pair {a, b}."""
+    if a == b:
+        raise KeyError(f"a lever does not pair with itself: {a!r}")
+    earlier, later = sorted((a, b))
+    return lever_manifests()[later].verdicts[earlier]
+
+
+def refusal_reason(a: str, b: str, constraint: Optional[str] = None) -> str:
+    """The single-source refusal message for a guard site.
+
+    ``constraint=None`` -> the pair's outright refusal reason;
+    ``constraint="tag"`` -> the tagged constrained-composition reason.
+    Raises KeyError/ValueError if the guard cites a verdict the
+    manifests do not declare — a guard with no declaration is a bug the
+    composition analyzer (MUR1400) surfaces before this ever raises in
+    production.
+    """
+    v = pair_verdict(a, b)
+    if constraint is None:
+        if v.kind != REFUSES:
+            raise ValueError(
+                f"pair ({a}, {b}) is declared '{v.kind}' — a guard site "
+                "citing an outright refusal needs a refuses() verdict"
+            )
+        assert v.reason is not None
+        return v.reason
+    reasons = dict(v.constraints)
+    if constraint not in reasons:
+        raise KeyError(
+            f"pair ({a}, {b}) declares no constraint {constraint!r} "
+            f"(has: {sorted(reasons)})"
+        )
+    return reasons[constraint]
+
+
+def declared_refusals() -> List[Tuple[str, str, Optional[str]]]:
+    """Every declared refusal as (earlier, later, constraint|None),
+    sorted — outright refusals plus constrained-composition tags."""
+    out: List[Tuple[str, str, Optional[str]]] = []
+    for later, manifest in sorted(lever_manifests().items()):
+        for earlier, v in sorted(manifest.verdicts.items()):
+            if v.kind == REFUSES:
+                out.append((earlier, later, None))
+            else:
+                for tag, _reason in v.constraints:
+                    out.append((earlier, later, tag))
+    return out
+
+
+def compatible_pairs() -> List[Tuple[str, str]]:
+    """Every declared-compatible unordered pair (earlier, later), sorted.
+    Constrained compositions count as compatible — their grid cell arms
+    the pair OUTSIDE the refused sub-configuration."""
+    out: List[Tuple[str, str]] = []
+    for later, manifest in sorted(lever_manifests().items()):
+        for earlier, v in sorted(manifest.verdicts.items()):
+            if v.kind == COMPOSES:
+                out.append((earlier, later))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AST discovery (the durability/snapshot.py discover_state_key_groups
+# pattern): find every module-level LEVER_MANIFEST without importing.
+# ---------------------------------------------------------------------------
+
+def discover_lever_manifests(pkg_root: Path) -> Dict[str, str]:
+    """AST-scan the package for module-level ``LEVER_MANIFEST``
+    assignments -> {module name: source path}.  MUR1400 checks this
+    against LEVER_MODULES both ways."""
+    found: Dict[str, str] = {}
+    for py in sorted(pkg_root.rglob("*.py")):
+        try:
+            tree = ast.parse(py.read_text(), filename=str(py))
+        except SyntaxError:
+            continue
+        modname = ".".join(py.relative_to(pkg_root.parent).with_suffix("").parts)
+        if modname.endswith(".__init__"):
+            modname = modname[: -len(".__init__")]
+        for node in tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.target is not None:
+                targets = [node.target]
+            for t in targets:
+                if isinstance(t, ast.Name) and t.id == "LEVER_MANIFEST":
+                    found[modname] = str(py)
+    return found

@@ -350,3 +350,25 @@ def init_compress_state(
     if spec.algorithm == "topk":
         out[REF_KEY] = np.asarray(init_flat, dtype)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="compression",
+    module="murmura_tpu.ops.compress",
+    state_keys_group="COMPRESS_STATE_KEYS",
+    stage="murmura.compress",
+    verdicts={
+        # The codec quantizes whatever broadcast the attack produced —
+        # the adaptation loop observes acceptance, not payload bytes.
+        "adaptive": composes(),
+    },
+)

@@ -468,39 +468,134 @@ def make_gang_mesh(
     )
 
 
-def _shard_gang_leading(tree: Any, mesh: Mesh) -> Any:
+def plan_gang_param_layout(
+    batch: int, num_nodes: int, param_shards: int, n_dev: int
+) -> Tuple[int, int, int]:
+    """(seed, nodes, param) axis sizes for a param-sharded GANG mesh —
+    the sharding x sweep lift (ISSUE 16).
+
+    Same largest-dividing-factor policy as :func:`plan_param_layout`:
+    prefer the full requested ``param_shards`` on the param axis (else
+    its largest divisor that divides the device count), then lay the
+    remaining devices as a ("seed", "nodes") gang plane under the
+    :func:`make_gang_mesh` policy — seed-major when the whole gang fits,
+    otherwise the largest seed factor whose node remainder divides N.
+    Raises when no factorization fits.
+    """
+    if param_shards < 1:
+        raise ValueError(f"param_shards must be >= 1, got {param_shards}")
+    for s in sorted(
+        (d for d in range(1, param_shards + 1) if param_shards % d == 0),
+        reverse=True,
+    ):
+        if n_dev % s:
+            continue
+        rem = n_dev // s
+        if batch * num_nodes <= rem:
+            return batch, num_nodes, s
+        for g in sorted(range(1, rem + 1), reverse=True):
+            if rem % g == 0 and g <= batch and batch % g == 0:
+                if num_nodes % (rem // g) == 0:
+                    return g, rem // g, s
+    raise ValueError(
+        f"cannot lay a gang of {batch} members x {num_nodes} nodes x "
+        f"{param_shards} param shards onto {n_dev} devices: no "
+        "(seed, nodes, param) factorization divides all three axes — "
+        "adjust tpu.num_devices, tpu.param_shards or the gang size"
+    )
+
+
+def make_gang_param_mesh(
+    batch: int,
+    num_nodes: int,
+    param_shards: int,
+    num_devices: Optional[int] = None,
+) -> Mesh:
+    """3-D ("seed", "nodes", "param") mesh for a param-sharded gang —
+    :func:`make_gang_mesh` composed with :func:`make_param_mesh`'s param
+    role, so the gang's [S, N, P] stacked state shards its trailing flat
+    axis too.  ``param_shards=1`` still yields the 3-D mesh (param axis
+    size 1), keeping one code path; every P("seed", "nodes")-spec'd
+    consumer works unchanged (absent/size-1 axes replicate)."""
+    devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(
+                f"Requested {num_devices} devices but only {len(devices)} available"
+            )
+        devices = devices[:num_devices]
+    seed_ax, node_ax, param_ax = plan_gang_param_layout(
+        batch, num_nodes, param_shards, len(devices)
+    )
+    sel = np.array(devices[: seed_ax * node_ax * param_ax])
+    return Mesh(
+        sel.reshape(seed_ax, node_ax, param_ax), ("seed", "nodes", "param")
+    )
+
+
+def _shard_gang_leading(
+    tree: Any, mesh: Mesh, flat_dim: Optional[int] = None
+) -> Any:
     """Sharding pytree for *stacked* [B, ...] gang state: [B, N, ...]
     leaves split ("seed", "nodes"), [B] per-member leaves split ("seed",),
     rank-0 leaves replicate.  Leaves whose second axis is not the node
-    axis (or not divisible by it) stay seed-sharded only."""
+    axis (or not divisible by it) stay seed-sharded only.  On a
+    param-sharded gang mesh (``flat_dim`` given), [B, N, flat_dim] leaves
+    additionally split their trailing flat axis over ("param",)."""
     gang2d = NamedSharding(mesh, P("seed", "nodes"))
     member = NamedSharding(mesh, P("seed"))
     repl = NamedSharding(mesh, P())
     node_ax = mesh.shape["nodes"]
+    param_ax = mesh_param_shards(mesh)
+    gang3d = (
+        NamedSharding(mesh, P("seed", "nodes", "param"))
+        if param_ax > 1 else gang2d
+    )
 
     def spec(leaf):
         if not hasattr(leaf, "ndim") or leaf.ndim == 0:
             return repl
         if leaf.ndim >= 2 and leaf.shape[1] % node_ax == 0:
+            if (
+                flat_dim is not None
+                and leaf.ndim == 3
+                and leaf.shape[2] == flat_dim
+            ):
+                return gang3d
             return gang2d
         return member
 
     return jax.tree_util.tree_map(spec, tree)
 
 
-def _gang_spec_from_template(tree: Any, mesh: Mesh) -> Any:
+def _gang_spec_from_template(
+    tree: Any, mesh: Mesh, flat_dim: Optional[int] = None
+) -> Any:
     """Sharding pytree for stacked gang inputs derived from the UNSTACKED
     per-member template (program.init_params / init_agg_state /
     data_arrays): a member leaf of rank >= 1 gains the gang axis in front
     ([B, N, ...] -> ("seed", "nodes")); a rank-0 member leaf becomes a [B]
-    per-member vector (("seed",))."""
+    per-member vector (("seed",)).  On a param-sharded gang mesh
+    (``flat_dim`` given), [N, flat_dim] member leaves stack to
+    [B, N, flat_dim] split ("seed", "nodes", "param")."""
     gang2d = NamedSharding(mesh, P("seed", "nodes"))
     member = NamedSharding(mesh, P("seed"))
     node_ax = mesh.shape["nodes"]
+    param_ax = mesh_param_shards(mesh)
+    gang3d = (
+        NamedSharding(mesh, P("seed", "nodes", "param"))
+        if param_ax > 1 else gang2d
+    )
 
     def spec(leaf):
         leaf = np.asarray(leaf)
         if leaf.ndim >= 1 and leaf.shape[0] % node_ax == 0:
+            if (
+                flat_dim is not None
+                and leaf.ndim == 2
+                and leaf.shape[-1] == flat_dim
+            ):
+                return gang3d
             return gang2d
         return member
 
@@ -543,8 +638,30 @@ def _shard_gang_round_fn(
     repl = NamedSharding(mesh, P())
     gang2d = NamedSharding(mesh, P("seed", "nodes"))
 
-    params_s = _gang_spec_from_template(program.init_params, mesh)
-    agg_s = _gang_spec_from_template(program.init_agg_state, mesh)
+    param_ax = mesh_param_shards(mesh)
+    flat_dim = None
+    if param_ax > 1:
+        # Param-sharded gang layout (the sharding x sweep lift): the
+        # member program must have been built with a matching shard
+        # count, exactly as in :func:`_shard_round_fn`.  Unlike the
+        # single-run path there is NO param_axis_scope here — under the
+        # gang vmap the [N, P] intermediates carry a leading member axis
+        # the scope's rank-2 constraints do not expect; the jit-boundary
+        # shardings pin the [B, N, P] layout and GSPMD propagates it
+        # through the vmapped body.
+        shards = getattr(program, "param_shards", 1)
+        flat_dim = getattr(program, "flat_dim", program.model_dim)
+        if shards % param_ax or flat_dim % param_ax:
+            raise ValueError(
+                f"gang mesh param axis {param_ax} does not divide the "
+                f"round program's param_shards={shards} (flat width "
+                f"{flat_dim}) — build the program with "
+                f"build_round_program(param_shards={param_ax}) (config: "
+                "tpu.param_shards) so the flat pad matches the mesh"
+            )
+
+    params_s = _gang_spec_from_template(program.init_params, mesh, flat_dim)
+    agg_s = _gang_spec_from_template(program.init_agg_state, mesh, flat_dim)
     data_s = _gang_spec_from_template(program.data_arrays, mesh)
 
     in_shardings = [
@@ -614,3 +731,51 @@ def shard_eval_step(eval_step, program, mesh: Mesh):
         in_shardings=(params_s, data_s),
         out_shardings=repl,
     )
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="sharding",
+    module="murmura_tpu.parallel.mesh",
+    mesh_axes=("param",),
+    verdicts={
+        "adaptive": composes(),
+        "compression": composes(
+            topk=(
+                "tpu.param_shards does not compose with compression."
+                "algorithm: topk (the per-row global top-k needs the "
+                "full [P] row resident on one device, defeating the "
+                "shard); use the int8 codec — its per-block scales "
+                "shard with P"
+            ),
+            int8_block=(
+                "a quant block straddling a shard boundary would "
+                "compute its scale across shards; pick a block that "
+                "divides the shard-local width"
+            ),
+        ),
+        "dmtt": refuses(
+            "tpu.param_shards does not compose with dmtt (the N x N "
+            "claim cross-evaluation unravels every broadcast row into "
+            "a full model per pair — there is no sharded formulation "
+            "of that sweep)"
+        ),
+        "faults": composes(),
+        "mobility": composes(),
+        "pipeline": composes(),
+        "population": refuses(
+            "tpu.param_shards does not compose with population yet "
+            "(the memmapped user bank stages full [P] rows per cohort "
+            "swap; a sharded bank is ROADMAP item 5's sharded-bank "
+            "leg)"
+        ),
+    },
+)

@@ -476,3 +476,44 @@ class PopulationNetwork(Network):
             # restored params are the resident cohort's rows and must
             # train on the same shards they did before the interruption.
             self._rebind_data(self.cohort)
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="population",
+    module="murmura_tpu.population.engine",
+    verdicts={
+        "adaptive": composes(),
+        # Stateless int8 survives cohort swaps; carried per-slot state
+        # (EF residual / topk reference) would cross user streams.
+        "compression": composes(
+            carried_state=(
+                "compression with carried state (error_feedback, or "
+                "algorithm: topk) does not compose with population "
+                "(cohort swaps reassign node slots); use stateless "
+                "int8 or disable the population block"
+            ),
+        ),
+        "dmtt": refuses(
+            "population does not compose with dmtt (trust state is "
+            "keyed by node identity, which cohort swaps reassign)"
+        ),
+        "faults": composes(),
+        "mobility": composes(),
+        "pipeline": refuses(
+            "exchange.pipeline does not compose with population "
+            "(the pipeline buffer is per-slot [N, P] carried state; "
+            "cohort swaps reassign node slots, so a buffered row "
+            "would be aggregated into the wrong user's stream — the "
+            "compression/staleness carried-state rationale)"
+        ),
+    },
+)

@@ -91,3 +91,33 @@ class MobilityModel:
             if not adj[i].any():
                 nearest = int(np.argmin(d[i]))
                 adj[i, nearest] = adj[nearest, i] = True
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="mobility",
+    module="murmura_tpu.topology.dynamic",
+    verdicts={
+        "adaptive": composes(),
+        "compression": composes(),
+        # dmtt NEEDS mobility's deterministic G^t; the constraint fires
+        # when dmtt is armed without it (and allow_static is unset).
+        "dmtt": composes(
+            requires_mobility=(
+                "dmtt requires a mobility section (claim verification "
+                "needs the deterministic G^t); set dmtt.allow_static: "
+                "true to verify claims against the static topology "
+                "instead"
+            ),
+        ),
+        "faults": composes(),
+    },
+)

@@ -179,3 +179,36 @@ class SparseTopology:
         for o in self.offsets:
             g = math.gcd(g, o)
         return g == 1
+
+
+# ---------------------------------------------------------------------------
+# Composition manifest (murmura_tpu/levers.py; `murmura check --compose`).
+# The single source of truth for this lever's cross-feature verdicts —
+# guard sites in config/schema.py and utils/factories.py cite
+# refusal_reason() so user-facing messages and the analyzer's grid can
+# never drift apart (MUR1400).
+# ---------------------------------------------------------------------------
+from murmura_tpu.levers import LeverManifest, composes, refuses
+
+LEVER_MANIFEST = LeverManifest(
+    name="sparse",
+    module="murmura_tpu.topology.sparse",
+    stage="murmura.exchange",
+    verdicts={
+        "adaptive": composes(),
+        "compression": composes(),
+        "dmtt": refuses(
+            "sparse topologies do not compose with dmtt (claim "
+            "verification needs the dense exchange graph)"
+        ),
+        "faults": composes(),
+        "mobility": refuses(
+            "sparse topologies do not compose with mobility (G^t is a "
+            "dense per-round graph); drop the mobility block or use a "
+            "dense topology"
+        ),
+        "pipeline": composes(),
+        "population": composes(),
+        "sharding": composes(),
+    },
+)

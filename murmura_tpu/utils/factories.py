@@ -15,6 +15,7 @@ from murmura_tpu.attacks import ATTACKS
 from murmura_tpu.attacks.base import Attack
 from murmura_tpu.config.schema import Config
 from murmura_tpu.core.network import Network
+from murmura_tpu.levers import refusal_reason
 from murmura_tpu.core.rounds import build_round_program
 from murmura_tpu.data.registry import build_federated_data
 from murmura_tpu.models.registry import build_model
@@ -562,18 +563,6 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             "distributed trains in per-node OS processes (run seeds as "
             "separate invocations there)"
         )
-    if config.backend == "tpu" and config.tpu.param_shards > 1:
-        # The schema validator catches sweep-block configs; the CLI
-        # `--seeds N` path reaches here with sweep=None and would
-        # otherwise DROP the requested sharding silently (the gang mesh
-        # has no param role yet) — at the model sizes the axis exists
-        # for, that is an OOM instead of a refusal.
-        raise ConfigError(
-            "tpu.param_shards does not compose with gang-batched "
-            "execution (murmura sweep / run --seeds) yet — the gang's "
-            "[S, N, P] stacked state would need a fourth mesh role; run "
-            "param-sharded experiments unganged"
-        )
     if config.backend == "tpu" and config.tpu.multihost and mesh is None:
         from murmura_tpu.parallel.mesh import init_multihost
 
@@ -610,18 +599,11 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
         # frontier sweeps sparse exponential graphs), but the gang MESH
         # still shards adjacency on node rows: the sparse mask needs the
         # edge_mask_sharding layout, which the gang path has not wired.
-        raise ConfigError(
-            "sparse topologies (exponential/one_peer) are not gang-"
-            "batchable on backend: tpu yet (the gang mesh lacks the "
-            "[k, N] edge-mask sharding layout) — use backend: simulation "
-            "for sparse gangs, or run sparse tpu experiments unganged"
-        )
+        raise ConfigError(refusal_reason("sparse", "sweep", "tpu_backend"))
     if config.population is not None and config.population.enabled:
-        raise ConfigError(
-            "population (cohort streaming) does not compose with "
-            "gang-batched execution — run cohort-streaming experiments "
-            "unganged"
-        )
+        # The CLI `--seeds N` path reaches here with sweep=None, so the
+        # schema's population x sweep validator never saw this pair.
+        raise ConfigError(refusal_reason("population", "sweep"))
     # ONE attack for the whole gang: its compromised placement is seeded by
     # attack.params.seed (default: the base experiment seed), never by the
     # member seed — member programs share the attack's static closures
@@ -630,10 +612,23 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
     attack = build_attack(config)
     mobility = build_mobility(config)
 
+    gang_param_shards = (
+        config.tpu.param_shards if config.backend == "tpu" else 1
+    )
     if config.backend == "tpu" and mesh is None:
-        from murmura_tpu.parallel.mesh import make_gang_mesh
+        if gang_param_shards > 1:
+            # The sharding x sweep lift (ISSUE 16): a 4-D-role
+            # ("seed", "nodes", "param") mesh so the gang's [S, N, P]
+            # stacked state shards its trailing flat axis too.
+            from murmura_tpu.parallel.mesh import make_gang_param_mesh
 
-        mesh = make_gang_mesh(batch, n, config.tpu.num_devices)
+            mesh = make_gang_param_mesh(
+                batch, n, gang_param_shards, config.tpu.num_devices
+            )
+        else:
+            from murmura_tpu.parallel.mesh import make_gang_mesh
+
+            mesh = make_gang_mesh(batch, n, config.tpu.num_devices)
     node_axis_sharded = (
         mesh is not None and dict(mesh.shape).get("nodes", 1) > 1
     )
@@ -701,7 +696,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
                 )
             if config.aggregation.algorithm == "evidential_trust":
                 probe_size = int(agg_params.get("max_eval_samples", 100))
-            from murmura_tpu.ops.flatten import model_dimension
+            from murmura_tpu.ops.flatten import model_dimension, padded_dim
             import jax
 
             model_dim = model_dimension(
@@ -709,9 +704,27 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             )
             if pallas_agg_enabled(config, node_axis_sharded):
                 agg_params.setdefault("pallas", True)
+            # Param-axis sharding pads the flat width (the
+            # build_network_from_config contract): rules sizing buffers
+            # from the flat dimension must see the padded width.
+            agg_flat_dim = padded_dim(model_dim, gang_param_shards)
+            if (
+                gang_param_shards > 1
+                and config.compression.algorithm == "int8"
+                and (agg_flat_dim // gang_param_shards)
+                % config.compression.block
+            ):
+                raise ConfigError(
+                    f"compression.block={config.compression.block} does "
+                    f"not divide the shard-local flat width "
+                    f"{agg_flat_dim // gang_param_shards} (model_dim "
+                    f"{model_dim} padded to {agg_flat_dim} over "
+                    f"tpu.param_shards={gang_param_shards}) — "
+                    + refusal_reason("compression", "sharding", "int8_block")
+                )
             agg = build_aggregator(
                 config.aggregation.algorithm, agg_params,
-                model_dim=model_dim, total_rounds=rounds,
+                model_dim=agg_flat_dim, total_rounds=rounds,
             )
         member_programs.append(_build_program(
             model,
@@ -736,6 +749,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             compression=build_compression_spec(config),
             staleness=build_staleness_spec(config, topology),
             pipeline=config.exchange.pipeline,
+            param_shards=gang_param_shards,
         ))
 
     writers = None
@@ -941,9 +955,8 @@ def build_network_from_config(
             f"divide the shard-local flat width "
             f"{agg_flat_dim // param_shards} (model_dim {model_dim} "
             f"padded to {agg_flat_dim} over tpu.param_shards="
-            f"{param_shards}) — a quant block straddling a shard "
-            "boundary would compute its scale across shards; pick a "
-            "block that divides the shard-local width"
+            f"{param_shards}) — "
+            + refusal_reason("compression", "sharding", "int8_block")
         )
     agg = build_aggregator(
         config.aggregation.algorithm, agg_params, model_dim=agg_flat_dim,

@@ -19,6 +19,7 @@ FRONTIER=0
 STALE=0
 PIPELINE=0
 SHARDED=0
+COMPOSE=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -31,6 +32,7 @@ while :; do
     --stale) STALE=1; shift;;
     --pipeline) PIPELINE=1; shift;;
     --sharded) SHARDED=1; shift;;
+    --compose) COMPOSE=1; shift;;
     *) break;;
   esac
 done
@@ -528,6 +530,68 @@ PYEOF
   fi
   echo "preflight sharded clean" | tee -a "$OUT/battery.log"
   run bench_scaling_sharded 7200 python bench_scaling.py --sharded --force
+fi
+# Optional composition-grid pre-flight (./run_tpu_battery.sh --compose
+# [outdir]): the ISSUE-16 gates on a forced 8-virtual-device CPU mesh —
+# (a) the MUR1400-1403 family must be clean (lever-manifest/guard
+# bijection with the executable refusal census; every
+# declared-compatible pair's composed round program recompile-free with
+# collective-inventory parity; composed-state/stage-order parity;
+# flow-taint preservation through the composed compress+stale and
+# sparse+stale cells), and (b) the lifted sharding x sweep cell — a
+# gang sweep on the 3-axis ("seed", "nodes", "param") mesh — must hold
+# end-to-end under tpu.recompile_guard.
+if [ "$COMPOSE" = 1 ]; then
+  echo "=== preflight: composition grid (MUR1400-1403 + lifted sharded sweep, CPU) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 1200 env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python - > "$OUT/preflight_compose.out" 2>&1 <<'PYEOF'
+import sys
+
+from murmura_tpu.analysis.composition import check_composition
+
+findings = check_composition()
+for f in findings:
+    print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+if findings:
+    print(f"FAIL: {len(findings)} MUR140x finding(s)")
+    sys.exit(1)
+print("MUR1400-1403 clean")
+
+from murmura_tpu.config import Config
+from murmura_tpu.utils.factories import build_gang_from_config
+
+cfg = Config.model_validate({
+    "experiment": {"name": "compose-preflight", "seed": 3, "rounds": 6},
+    "topology": {"type": "ring", "num_nodes": 8},
+    "aggregation": {"algorithm": "krum",
+                    "params": {"num_compromised": 1}},
+    "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+    "data": {"adapter": "synthetic",
+             "params": {"num_samples": 64, "input_shape": [16],
+                        "num_classes": 4}},
+    "model": {"factory": "mlp",
+              "params": {"input_dim": 16, "hidden_dims": [36],
+                         "num_classes": 4}},
+    "backend": "tpu",
+    "sweep": {"num_seeds": 2},
+    "tpu": {"param_shards": 2, "param_dtype": "float32",
+            "compute_dtype": "float32", "recompile_guard": True},
+})
+gang = build_gang_from_config(cfg)
+assert tuple(gang.mesh.axis_names) == ("seed", "nodes", "param"), \
+    gang.mesh.axis_names
+gang.train(rounds=6, verbose=False)
+finals = [h["mean_loss"][-1] for h in gang.histories]
+assert all(l == l for l in finals), finals  # finite
+print(f"guarded lifted sweep ok: mesh {dict(gang.mesh.shape)}, "
+      f"final losses {[round(float(l), 4) for l in finals]}")
+PYEOF
+  then
+    echo "preflight compose FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_compose.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight compose clean" | tee -a "$OUT/battery.log"
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

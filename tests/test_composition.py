@@ -1,0 +1,299 @@
+"""Cross-feature composition grid (analysis/composition.py, MUR1400-1403)
+— ISSUE 16.
+
+The repo-wide "grid is clean" assertion is the slow
+``test_full_composition_check_clean`` gate (the check_sharded idiom);
+tier-1 pins the *mechanisms*: the manifest census counts, the
+guard<->manifest bijection with committed negatives (an undeclared
+refusal-phrase literal, an uncited declaration, a stale citation),
+the refusal-message<->manifest regression, representative grid cells
+including the lifted sharding x sweep 3-axis mesh, and one MUR1403
+negative driven through an injectable leaky stale fold.
+"""
+
+import copy
+import json
+import re
+
+import numpy as np
+import pytest
+
+from murmura_tpu import levers
+from murmura_tpu.analysis import composition
+
+
+class TestManifests:
+    """The declaration protocol itself: coverage, counts, bijections."""
+
+    def test_every_lever_declares_a_manifest(self):
+        manifests = levers.lever_manifests()
+        assert set(manifests) == set(levers.LEVER_MODULES)
+
+    def test_discovery_matches_registry(self):
+        from pathlib import Path
+
+        import murmura_tpu
+
+        pkg_root = Path(murmura_tpu.__file__).resolve().parent
+        discovered = levers.discover_lever_manifests(pkg_root)
+        assert set(discovered) == set(levers.LEVER_MODULES.values())
+
+    def test_census_counts(self):
+        """ISSUE 16 acceptance: the sharding x sweep lift moved the
+        outright-refusal census 15 -> 14."""
+        refusals = levers.declared_refusals()
+        outright = [r for r in refusals if r[2] is None]
+        constrained = [r for r in refusals if r[2] is not None]
+        assert len(outright) == 14
+        assert len(constrained) == 7
+        assert len(levers.compatible_pairs()) == 41
+
+    def test_sharding_sweep_is_lifted(self):
+        assert ("sharding", "sweep") in levers.compatible_pairs()
+        assert not any(
+            (a, b) == ("sharding", "sweep")
+            for a, b, _tag in levers.declared_refusals()
+        )
+        assert ("sharding", "sweep") in composition.LIFTED_PAIRS
+
+    def test_pair_verdict_owner_is_later_lever(self):
+        v = levers.pair_verdict("sweep", "sharding")  # order-insensitive
+        assert v.kind == "composes"
+
+    def test_manifest_bijection_clean(self):
+        assert composition.check_manifest_bijection() == []
+
+    def test_reserved_state_groups_disjoint(self):
+        assert composition.check_composed_state() == []
+
+    def test_composition_json_matches_live_manifests(self):
+        assert composition._census_drift_findings() == []
+        committed = json.loads(composition.COMPOSITION_JSON.read_text())
+        assert committed["refusal_count"] == 14
+        assert committed["previous_refusal_count"] == 15
+        assert ["sharding", "sweep"] in committed["lifted"]
+
+
+class TestRefusalGuards:
+    """MUR1400: guard sites <-> manifest declarations, both directions."""
+
+    def test_live_guard_sources_clean(self):
+        assert composition.refusal_guard_findings() == []
+
+    def test_undeclared_phrase_literal_is_a_finding(self):
+        """A refusal-shaped message that bypasses refusal_reason(...)
+        must fire MUR1400 (ISSUE 16 testable negative #1)."""
+        doctored = (
+            'MSG = "population streaming does not compose with frobnication"\n'
+        )
+        findings = composition.refusal_guard_findings(
+            factories_src=doctored
+        )
+        assert any(
+            f.rule == "MUR1400" and "not routed through refusal_reason"
+            in f.message
+            for f in findings
+        )
+
+    def test_undeclared_citation_is_a_finding(self):
+        """Citing a refusal the manifests no longer declare (e.g. the
+        lifted sharding x sweep pair) must fire MUR1400."""
+        doctored = 'raise ValueError(refusal_reason("sharding", "sweep"))\n'
+        findings = composition.refusal_guard_findings(schema_src=doctored)
+        assert any(
+            f.rule == "MUR1400"
+            and "manifests declare no such refusal" in f.message
+            for f in findings
+        )
+
+    def test_stale_declaration_is_a_finding(self):
+        """Removing every guard citation leaves each declared refusal
+        uncited — MUR1400 stale-declaration findings (ISSUE 16 testable
+        negative #2)."""
+        findings = composition.refusal_guard_findings(
+            schema_src="", factories_src=""
+        )
+        stale = [f for f in findings if "stale declaration" in f.message]
+        assert len(stale) == len(levers.declared_refusals())
+
+    def test_dynamic_citation_is_a_finding(self):
+        doctored = "reason = refusal_reason(a_var, b_var)\n"
+        findings = composition.refusal_guard_findings(schema_src=doctored)
+        assert any(
+            "non-literal arguments" in f.message for f in findings
+        )
+
+    def test_refusal_message_cites_manifest_verbatim(self):
+        """Satellite 2 regression: the ValidationError a user sees IS
+        the manifest's declared reason."""
+        from murmura_tpu.config.schema import Config
+
+        raw = composition._census_raw(
+            composition.REFUSAL_CONFIGS[("adaptive", "pipeline", None)]
+        )
+        reason = levers.refusal_reason("adaptive", "pipeline")
+        with pytest.raises(Exception, match=re.escape(reason)):
+            Config.model_validate(raw)
+
+    def test_census_covers_every_declared_refusal(self):
+        assert set(composition.REFUSAL_CONFIGS) == set(
+            levers.declared_refusals()
+        )
+
+    def test_census_representative_cells(self):
+        for key in (
+            ("adaptive", "dmtt", None),
+            ("compression", "sharding", "int8_block"),
+            ("sparse", "sweep", "tpu_backend"),
+        ):
+            assert (
+                composition.census_cell_findings(
+                    key, composition.REFUSAL_CONFIGS[key]
+                )
+                == []
+            )
+
+
+class TestGrid:
+    """MUR1401/MUR1402 representative composed cells (the full grid is
+    the slow gate)."""
+
+    def test_compression_staleness_cell(self):
+        assert composition.grid_cell_findings("compression", "staleness") == []
+
+    def test_pipeline_staleness_cell(self):
+        """Pins the documented pipe_bcast buffer-reuse exemption
+        (core/pipeline.pipeline_state_keys) and the pipelined
+        leading-aggregate stage order."""
+        assert composition.grid_cell_findings("pipeline", "staleness") == []
+
+    def test_lifted_sharding_sweep_cell(self):
+        """ISSUE 16 tentpole: the lifted pair composes make_gang_mesh
+        with make_param_mesh on a ("seed", "nodes", "param") mesh and is
+        rebuild-deterministic."""
+        from murmura_tpu.analysis.ir import _ensure_host_devices
+
+        _ensure_host_devices(8)
+        raw = composition.pair_raw("sharding", "sweep")
+        gang, is_gang = composition._build_cell(composition._validate(raw))
+        assert is_gang
+        assert tuple(gang.mesh.axis_names) == ("seed", "nodes", "param")
+        assert dict(gang.mesh.shape)["param"] > 1
+        assert composition._lifted_cell_findings(gang, raw) == []
+
+    def test_grid_cell_emits_compose_summary(self):
+        composition._COMPOSE_SUMMARIES.clear()
+        assert composition.grid_cell_findings("faults", "mobility") == []
+        rows = [
+            r
+            for r in composition.compose_summaries()
+            if r["pair"] == ["faults", "mobility"]
+        ]
+        assert rows and rows[0]["kind"] == "compose_summary"
+        assert rows[0]["verdict"] == "composes"
+        assert rows[0]["recompiles"] == 0
+        assert rows[0]["clean"] is True
+
+
+class TestComposedTaint:
+    """MUR1403: flow-taint preservation with a second lever in the loop."""
+
+    def test_compressed_stale_krum_clean(self):
+        assert (
+            composition.composed_taint_findings("compressed_stale", "krum")
+            == []
+        )
+
+    def test_sparse_stale_krum_clean(self):
+        assert (
+            composition.composed_taint_findings("sparse_stale", "krum") == []
+        )
+
+    def test_leaky_fold_fires_mur1403(self):
+        """ISSUE 16 testable negative #3: a stale fold that mixes the
+        broadcast across senders widens every rule's per-coordinate
+        influence past its declared bound."""
+        import jax.numpy as jnp
+
+        from murmura_tpu.core.stale import make_stale_fold
+
+        def leaky_factory(spec, sparse_offsets=()):
+            real = make_stale_fold(spec, sparse_offsets=sparse_offsets)
+
+            def fold(bcast, adj, state, alive, scrub_ok):
+                be, ae, updates, stats = real(
+                    bcast, adj, state, alive, scrub_ok
+                )
+                # Cross-sender contamination: every row now carries
+                # every sender's labels.
+                be = be + jnp.sum(be, axis=0, keepdims=True) * 1e-6
+                return be, ae, updates, stats
+
+            return fold
+
+        findings = composition.composed_taint_findings(
+            "compressed_stale", "krum", fold_factory=leaky_factory
+        )
+        assert findings
+        assert all(f.rule == "MUR1403" for f in findings)
+
+
+class TestWiring:
+    """The --compose pass is registered everywhere the other passes are."""
+
+    def test_family_registry(self):
+        assert set(composition.COMPOSE_CHECK_FAMILIES) == {
+            "check_manifest_bijection",
+            "check_refusal_census",
+            "check_composition_grid",
+            "check_composed_state",
+            "check_composed_taint",
+        }
+
+    def test_entry_point_registered_for_coverage(self):
+        from murmura_tpu.analysis import ir
+
+        assert "check_composition" in ir._CHECK_ENTRY_POINTS
+
+    def test_cli_exposes_compose_flag(self):
+        from murmura_tpu.cli import check as check_cmd
+
+        assert "--compose" in {
+            p for param in check_cmd.params for p in param.opts
+        }
+
+    def test_compose_summary_rides_check_json(self):
+        from murmura_tpu.analysis import format_findings_json
+
+        row = {
+            "kind": "compose_summary",
+            "pair": ["faults", "mobility"],
+            "verdict": "composes",
+        }
+        lines = format_findings_json([], [row]).splitlines()
+        assert json.loads(lines[0])["kind"] == "compose_summary"
+
+    @pytest.mark.slow
+    def test_full_composition_check_clean(self):
+        findings = composition.check_composition()
+        assert findings == [], "\n".join(
+            f"{f.path}:{f.line}: {f.rule} {f.message}" for f in findings
+        )
+
+
+class TestExampleConfigs:
+    """Satellite 3: every shipped example config parses and validates."""
+
+    def test_every_example_config_validates(self):
+        from pathlib import Path
+
+        from murmura_tpu.config import Config, load_config
+
+        configs = sorted(
+            (Path(__file__).resolve().parent.parent / "examples" / "configs")
+            .glob("*.yaml")
+        )
+        assert len(configs) >= 20
+        for path in configs:
+            cfg = load_config(path)
+            assert isinstance(cfg, Config), path.name

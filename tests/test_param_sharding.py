@@ -295,18 +295,27 @@ class TestRejections:
         with pytest.raises(Exception, match="backend"):
             _cfg(backend="simulation")
 
-    def test_sweep_rejected(self):
-        with pytest.raises(Exception, match="sweep"):
-            _cfg(sweep={"seeds": [1, 2]})
+    def test_sweep_composes(self):
+        # LIFTED (ISSUE 16): sharding x sweep is a declared-compatible
+        # pair — the schema accepts the combination (the gang mesh grew
+        # a "param" role; murmura_tpu/levers.py manifests).
+        cfg = _cfg(sweep={"num_seeds": 2})
+        assert cfg.sweep is not None
+        assert cfg.tpu.param_shards == 4
 
-    def test_gang_seeds_path_rejected(self):
-        # The CLI `run --seeds N` path bypasses the schema's sweep-block
-        # validator (sweep=None, explicit seed list) — the gang builder
-        # itself must refuse rather than silently drop the sharding.
+    def test_gang_seeds_path_lifts_to_param_mesh(self):
+        # The CLI `run --seeds N` path (sweep=None, explicit seed list):
+        # the gang now lays a ("seed", "nodes", "param") mesh instead of
+        # refusing, and trains with finite per-member metrics.
         from murmura_tpu.utils.factories import build_gang_from_config
 
-        with pytest.raises(ConfigError, match="unganged"):
-            build_gang_from_config(_cfg(), seeds=[7, 8])
+        gang = build_gang_from_config(_cfg(), seeds=[7, 8])
+        assert gang.mesh is not None
+        assert gang.mesh.axis_names == ("seed", "nodes", "param")
+        assert dict(gang.mesh.shape)["param"] > 1
+        gang.train(rounds=2)
+        for h in gang.histories:
+            assert np.isfinite(np.asarray(h["mean_loss"])).all()
 
     def test_population_rejected(self):
         with pytest.raises(Exception, match="population"):

@@ -228,6 +228,21 @@ def _peak_flops(device_kind: str):
     return None
 
 
+def _memory_block(network) -> dict:
+    """The per-run static-residency line (XLA ``memory_analysis()`` of the
+    round step, free off the cost line's shared AOT compile): same fields
+    the MUR1500 budget sweep gates on (analysis/memory.py), so drift
+    between committed MEMORY.json and the bench's own footprint is
+    visible in one diff."""
+    mem = network.step_memory_analysis()
+    return {
+        "temp_bytes": mem["temp_bytes"],
+        "argument_bytes": mem["argument_bytes"],
+        "output_bytes": mem["output_bytes"],
+        "peak_bytes": mem["peak_bytes"],
+    }
+
+
 def bench_config(on_cpu: bool, num_nodes: int = 20,
                  param_dtype: str = "float32", exchange: str = "allgather",
                  sweep: dict = None, compression: dict = None):
@@ -381,11 +396,15 @@ def main():
         # line the `murmura check --ir` budget sweep gates on
         # (analysis/budgets.py) — drift between committed budgets and the
         # bench's own cost line is then visible in one diff.
-        flops = bytes_accessed = None
+        flops = bytes_accessed = memory = None
         try:
             cost = network.step_cost_analysis()
             flops = float(cost.get("flops", 0.0)) or None
             bytes_accessed = float(cost.get("bytes accessed", 0.0)) or None
+        except Exception:
+            pass
+        try:
+            memory = _memory_block(network)
         except Exception:
             pass
         return {
@@ -396,6 +415,7 @@ def main():
             "elapsed": elapsed,
             "flops": flops,
             "bytes_accessed": bytes_accessed,
+            "memory": memory,
         }
 
     def measure_gang(gang_size: int, gang_rounds: int) -> dict:
@@ -489,6 +509,10 @@ def main():
             rec["bytes_accessed"] = float(
                 cost.get("bytes accessed", 0.0)
             ) or None
+        except Exception:
+            pass
+        try:
+            rec["memory"] = _memory_block(network)
         except Exception:
             pass
         ce = network.history.get("agg_compress_error")

@@ -407,6 +407,18 @@ def run_point(
         degree = len(network.topology.offsets)
         exchange_bytes = degree * nodes * int(network.program.model_dim) * itemsize
 
+    # Static XLA residency of the round step (memory_analysis() off the
+    # cost line's shared AOT compile — nothing executes): the same fields
+    # the MUR1500 budget sweep gates on, recorded next to the *runtime*
+    # peaks below so allocator overhead vs compiled footprint is one diff.
+    memory = None
+    try:
+        from bench import _memory_block
+
+        memory = _memory_block(network)
+    except Exception:
+        pass
+
     mem = {}
     stats = jax.local_devices()[0].memory_stats() or {}
     if "peak_bytes_in_use" in stats:
@@ -437,6 +449,7 @@ def run_point(
         **({"cost": cost,
             "degree": degree,
             "exchange_bytes_per_round": exchange_bytes} if sparse else {}),
+        **({"memory": memory} if memory else {}),
         **mem,
     }))
 

@@ -46,6 +46,7 @@ def run_check_detailed(
     pipeline: Optional[bool] = None,
     sharded: Optional[bool] = None,
     compose: Optional[bool] = None,
+    memory: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -82,12 +83,21 @@ def run_check_detailed(
     pairwise grid over every declared-compatible pair — recompile-free
     composed builds with collective-inventory parity — composed
     carried-state/stage-order parity, and flow-taint preservation on
-    composed cells).
+    composed cells), and when ``memory`` is enabled the static memory
+    contracts (analysis/memory.py, MUR1500-1503: committed
+    ``memory_analysis()`` budgets per (rule x topology x feature) grid
+    cell against analysis/MEMORY.json, per-device peak shrinking
+    ~P/shards across shards {1, 2, 4} on the param mesh, donation
+    completeness per carried leaf against the MUR900 key-group
+    registry, and the overlap-dependence proof that the pipelined
+    program's buffered aggregation has no def-use path from the round's
+    training subgraph).
     ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
     ``staleness=None``/``pipeline=None``/``sharded=None``/
-    ``compose=None`` mean "on for the package check, off for explicit
-    paths" (all eight passes are package-global: they exercise the live
-    registry, not the files named on the command line).
+    ``compose=None``/``memory=None`` mean "on for the package check,
+    off for explicit paths" (all nine passes are package-global: they
+    exercise the live registry, not the files named on the command
+    line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -95,7 +105,10 @@ def run_check_detailed(
     cells) and one ``{"kind": "flow_summary", ...}`` per (rule, exchange
     mode) flow cell with its per-node taint-set payload, plus one
     ``{"kind": "compose_summary", ...}`` per composition-grid pair with
-    its verdict, cell kind and recompile count.
+    its verdict, cell kind and recompile count, and one
+    ``{"kind": "memory_summary", ...}`` per memory grid cell (measured
+    vs committed temp/argument/output/generated/peak bytes, including
+    in-tolerance cells).
     """
     run_ir = ir if ir is not None else not paths
     run_flow = flow if flow is not None else not paths
@@ -105,6 +118,7 @@ def run_check_detailed(
     run_pipeline = pipeline if pipeline is not None else not paths
     run_sharded = sharded if sharded is not None else not paths
     run_compose = compose if compose is not None else not paths
+    run_memory = memory if memory is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -149,6 +163,11 @@ def run_check_detailed(
 
         findings.extend(composition_mod.check_composition())
         records.extend(composition_mod.compose_summaries())
+    if run_memory:
+        from murmura_tpu.analysis import memory as memory_mod
+
+        findings.extend(memory_mod.check_memory())
+        records.extend(memory_mod.memory_summaries())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -164,13 +183,14 @@ def run_check(
     pipeline: Optional[bool] = None,
     sharded: Optional[bool] = None,
     compose: Optional[bool] = None,
+    memory: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
         adaptive=adaptive, staleness=staleness, pipeline=pipeline,
-        sharded=sharded, compose=compose,
+        sharded=sharded, compose=compose, memory=memory,
     )[0]
 
 

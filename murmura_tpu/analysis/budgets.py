@@ -71,15 +71,26 @@ def _cpu_device():
         return None
 
 
-def measure_cell(
+_COMPILED_MEMO: Dict[Tuple[str, int, bool, Optional[int], bool], Any] = {}
+
+
+def compiled_cell(
     name: str, n: int, circulant: bool, dim: Optional[int] = None,
     pallas: bool = False,
-) -> Dict[str, float]:
-    """AOT-compile one canonical cell on CPU and read XLA's cost model."""
+):
+    """The ONE memoized AOT compile of a canonical cell on CPU, shared by
+    every consumer of the grid — the MUR206 cost gate reads its
+    ``cost_analysis()``, memory consumers its ``memory_analysis()`` and
+    HLO text — so adding a metric family never adds a compile sweep
+    (the flow-memoization precedent; ``Network.step_cost_analysis`` /
+    ``step_memory_analysis`` share their compile the same way)."""
     import jax
 
     from murmura_tpu.analysis import ir
 
+    key = (name, n, circulant, dim, pallas)
+    if key in _COMPILED_MEMO:
+        return _COMPILED_MEMO[key]
     params = (
         dict(ir.AGG_CASES.get(name, {}), pallas=True) if pallas else None
     )
@@ -89,9 +100,20 @@ def measure_cell(
     dev = _cpu_device()
     cm = jax.default_device(dev) if dev is not None else contextlib.nullcontext()
     with cm:
-        cost = normalize_cost_analysis(
-            jax.jit(prog.fn).lower(*prog.args).compile().cost_analysis()
-        )
+        compiled = jax.jit(prog.fn).lower(*prog.args).compile()
+    _COMPILED_MEMO[key] = compiled
+    return compiled
+
+
+def measure_cell(
+    name: str, n: int, circulant: bool, dim: Optional[int] = None,
+    pallas: bool = False,
+) -> Dict[str, float]:
+    """Read XLA's cost model off the shared compiled cell."""
+    cost = normalize_cost_analysis(
+        compiled_cell(name, n, circulant, dim=dim, pallas=pallas)
+        .cost_analysis()
+    )
     return {
         "flops": float(cost.get("flops", 0.0)),
         "bytes": float(cost.get("bytes accessed", 0.0)),

@@ -128,7 +128,7 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 _CHECK_ENTRY_POINTS = frozenset(
     {"check_ir", "check_coverage", "check_flow", "check_durability",
      "check_adaptive", "check_staleness", "check_pipeline",
-     "check_sharded", "check_composition"}
+     "check_sharded", "check_composition", "check_memory"}
 )
 
 
@@ -1688,6 +1688,13 @@ def check_coverage() -> List[Finding]:
     findings.extend(
         _unwired_family_findings(
             composition_mod, composition_mod.COMPOSE_CHECK_FAMILIES
+        )
+    )
+    from murmura_tpu.analysis import memory as memory_mod
+
+    findings.extend(
+        _unwired_family_findings(
+            memory_mod, memory_mod.MEMORY_CHECK_FAMILIES
         )
     )
     return findings

@@ -142,6 +142,12 @@ RULES: Dict[str, str] = {
     "MUR1401": "composition-grid",
     "MUR1402": "composition-state-stages",
     "MUR1403": "composition-influence",
+    # 15xx = static memory contracts (analysis/memory.py,
+    # `check --memory`; docs/ANALYSIS.md "Memory contracts")
+    "MUR1500": "memory-budget",
+    "MUR1501": "sharded-memory-scaling",
+    "MUR1502": "donation-completeness",
+    "MUR1503": "overlap-dependence",
 }
 
 

@@ -681,18 +681,35 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "explicit PATHS are given.",
 )
 @click.option(
+    "--memory/--no-memory", "memory", default=None,
+    help="Run the static memory contracts (MUR1500-1503: committed "
+         "memory_analysis() budgets per (rule x topology x feature) "
+         "round-program cell against analysis/MEMORY.json, per-device "
+         "peak ~P/shards across shards {1,2,4}, donation completeness "
+         "per carried leaf, and the pipelined overlap-dependence "
+         "proof).  AOT-compiles the full grid (~3 min on CPU; the "
+         "compiles are shared across all four contracts).  Default: on "
+         "for the package check, off when explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary / "
-         "compose-summary records) as JSON lines for editor/CI "
-         "annotation instead of the greppable text format.",
+         "compose-summary / memory-summary records) as JSON lines for "
+         "editor/CI annotation instead of the greppable text format.",
 )
 @click.option(
     "--update-budgets", is_flag=True, default=False,
     help="Re-measure the AOT cost grid and rewrite analysis/BUDGETS.json; "
          "review the diff as perf history.",
 )
+@click.option(
+    "--update-memory", is_flag=True, default=False,
+    help="Re-measure the AOT memory grid and rewrite "
+         "analysis/MEMORY.json; review the diff as residency history.",
+)
 def check(paths, contracts, ir, flow, durability, adaptive, staleness,
-          pipeline, sharded, compose, as_json, update_budgets):
+          pipeline, sharded, compose, memory, as_json, update_budgets,
+          update_memory):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -707,8 +724,9 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     adaptive-adversary contracts (MUR1000-1003 via --adaptive), the
     bounded-staleness contracts (MUR1100-1103 via --staleness), the
     pipelined-rounds contracts (MUR1200-1203 via --pipeline), the
-    param-axis sharding contracts (MUR1300-1303 via --sharded), and the
-    cross-feature composition grid (MUR1400-1403 via --compose).
+    param-axis sharding contracts (MUR1300-1303 via --sharded), the
+    cross-feature composition grid (MUR1400-1403 via --compose), and the
+    static memory contracts (MUR1500-1503 via --memory).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -722,6 +740,15 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
             "as perf history"
         )
         return
+    if update_memory:
+        from murmura_tpu.analysis import memory as memory_mod
+
+        path = memory_mod.update_memory()
+        console.print(
+            f"Memory budgets rewritten to [bold]{path}[/bold] — review "
+            "the diff as residency history"
+        )
+        return
     from murmura_tpu.analysis import (
         format_findings,
         format_findings_json,
@@ -731,7 +758,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     findings, records = run_check_detailed(
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
         durability=durability, adaptive=adaptive, staleness=staleness,
-        pipeline=pipeline, sharded=sharded, compose=compose,
+        pipeline=pipeline, sharded=sharded, compose=compose, memory=memory,
     )
     if as_json:
         out = format_findings_json(findings, records)

@@ -463,6 +463,32 @@ class Network:
             ),
         }
 
+    def _step_compiled(self):
+        """AOT-compile the train step on the shapes ``train`` runs.
+
+        Memoized so :meth:`step_cost_analysis` and
+        :meth:`step_memory_analysis` (and any future AOT introspection)
+        share one compile — the jit cache is keyed on the same shapes, so
+        ``train`` afterwards still hits it and nothing executes here.
+        """
+        compiled = getattr(self, "_aot_compiled", None)
+        if compiled is not None:
+            return compiled
+        args = [
+            self.params,
+            self.agg_state,
+            jax.random.PRNGKey(0),
+            jnp.asarray(self._adjacency_for_round(self.current_round)),
+            jnp.asarray(self.compromised),
+            jnp.asarray(0.0, dtype=jnp.float32),
+            self._data,
+        ]
+        if self.program.faulted:
+            args.insert(5, jnp.asarray(self._alive_for_round(self.current_round)))
+        compiled = self._step.lower(*args).compile()
+        self._aot_compiled = compiled
+        return compiled
+
     def step_cost_analysis(self) -> Dict[str, float]:
         """XLA cost analysis of the compiled train step (flops, bytes).
 
@@ -477,19 +503,24 @@ class Network:
         """
         from murmura_tpu.analysis.budgets import normalize_cost_analysis
 
-        args = [
-            self.params,
-            self.agg_state,
-            jax.random.PRNGKey(0),
-            jnp.asarray(self._adjacency_for_round(self.current_round)),
-            jnp.asarray(self.compromised),
-            jnp.asarray(0.0, dtype=jnp.float32),
-            self._data,
-        ]
-        if self.program.faulted:
-            args.insert(5, jnp.asarray(self._alive_for_round(self.current_round)))
-        return normalize_cost_analysis(
-            self._step.lower(*args).compile().cost_analysis()
+        return normalize_cost_analysis(self._step_compiled().cost_analysis())
+
+    def step_memory_analysis(self) -> Dict[str, float]:
+        """XLA memory analysis of the compiled train step (bytes).
+
+        Runtime twin of the MUR1500 memory-budget sweep (``murmura check
+        --memory``, analysis/memory.py — which owns the cross-version
+        normalization used here).  Shares the AOT compile with
+        :meth:`step_cost_analysis`, so asking for both costs one compile.
+        ``peak_bytes`` is the static accounting identity
+        arguments + outputs - aliased + temporaries + generated code; on
+        backends whose ``memory_analysis()`` lacks a field it contributes
+        zero rather than failing.
+        """
+        from murmura_tpu.analysis.memory import normalize_memory_analysis
+
+        return normalize_memory_analysis(
+            self._step_compiled().memory_analysis()
         )
 
     def train(

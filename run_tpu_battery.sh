@@ -20,6 +20,7 @@ STALE=0
 PIPELINE=0
 SHARDED=0
 COMPOSE=0
+MEMORY=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -33,6 +34,7 @@ while :; do
     --pipeline) PIPELINE=1; shift;;
     --sharded) SHARDED=1; shift;;
     --compose) COMPOSE=1; shift;;
+    --memory) MEMORY=1; shift;;
     *) break;;
   esac
 done
@@ -592,6 +594,62 @@ PYEOF
     exit 1
   fi
   echo "preflight compose clean" | tee -a "$OUT/battery.log"
+fi
+# Optional memory-contract pre-flight (./run_tpu_battery.sh --memory
+# [outdir]): the ISSUE-17 gates on a forced 8-virtual-device CPU mesh —
+# the MUR1500-1503 family must be clean end to end: the committed
+# memory_analysis() budget grid (analysis/MEMORY.json) over every
+# (rule x topology x feature) cell, the sharded per-device-peak scaling
+# law across shards {1, 2, 4} (needs the 8-device mesh, hence the forced
+# host platform count), donation completeness per carried leaf, and the
+# pipelined overlap-dependence proof (buffered aggregation independent
+# of the round's training subgraph, with its serialized positive
+# control).  A budget drift, an unaliased carry, or a dependence edge
+# from train into the pipelined combine aborts the battery before a
+# chip-second is spent — the residency numbers the battery records would
+# be measuring a different program than the one the budgets describe.
+if [ "$MEMORY" = 1 ]; then
+  echo "=== preflight: memory contracts (MUR1500-1503, CPU) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 1800 env JAX_PLATFORMS=cpu XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      python - > "$OUT/preflight_memory.out" 2>&1 <<'PYEOF'
+import sys
+
+from murmura_tpu.analysis.memory import (
+    check_memory,
+    overlap_cell_findings,
+    scaling_cell_findings,
+)
+
+# The full family: MUR1500 budget grid, MUR1501 scaling law (live on the
+# forced 8-device mesh), MUR1502 donation walk, MUR1503 dependence proof
+# incl. the doctored-combine negative control.
+findings = check_memory()
+for f in findings:
+    print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+if findings:
+    print(f"FAIL: {len(findings)} MUR150x finding(s)")
+    sys.exit(1)
+print("MUR1500-1503 clean")
+
+# Belt-and-braces: re-run one sharded scaling cell and the pipelined
+# dependence cell directly so the preflight log names them even if the
+# family-level memoization ever changes what the default gate covers.
+extra = list(scaling_cell_findings("krum", "circulant"))
+extra += list(overlap_cell_findings("fedavg", "dense"))
+for f in extra:
+    print(f"{f.path}:{f.line}: {f.rule} {f.message}")
+if extra:
+    print(f"FAIL: {len(extra)} finding(s) in the named cells")
+    sys.exit(1)
+print("scaling cell (krum/circulant, shards 1-2-4) + "
+      "overlap cell (fedavg/dense) clean")
+PYEOF
+  then
+    echo "preflight memory FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_memory.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight memory clean" | tee -a "$OUT/battery.log"
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

@@ -47,6 +47,7 @@ def run_check_detailed(
     sharded: Optional[bool] = None,
     compose: Optional[bool] = None,
     memory: Optional[bool] = None,
+    serve: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -91,13 +92,19 @@ def run_check_detailed(
     completeness per carried leaf against the MUR900 key-group
     registry, and the overlap-dependence proof that the pipelined
     program's buffered aggregation has no def-use path from the round's
-    training subgraph).
+    training subgraph), and when ``serve`` is enabled the serving
+    contracts (analysis/serve.py, MUR1600-1603: bucket-key soundness —
+    two cells share a scheduler bucket ⇔ their independently-traced
+    jaxpr skeletons are structurally equal — zero recompiles across
+    warm-bucket admissions, frozen-lane non-interference under
+    eviction, and daemon kill+recover resume completeness with
+    byte-identical histories).
     ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
     ``staleness=None``/``pipeline=None``/``sharded=None``/
-    ``compose=None``/``memory=None`` mean "on for the package check,
-    off for explicit paths" (all nine passes are package-global: they
-    exercise the live registry, not the files named on the command
-    line).
+    ``compose=None``/``memory=None``/``serve=None`` mean "on for the
+    package check, off for explicit paths" (all ten passes are
+    package-global: they exercise the live registry, not the files
+    named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -119,6 +126,7 @@ def run_check_detailed(
     run_sharded = sharded if sharded is not None else not paths
     run_compose = compose if compose is not None else not paths
     run_memory = memory if memory is not None else not paths
+    run_serve = serve if serve is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -168,6 +176,10 @@ def run_check_detailed(
 
         findings.extend(memory_mod.check_memory())
         records.extend(memory_mod.memory_summaries())
+    if run_serve:
+        from murmura_tpu.analysis import serve as serve_mod
+
+        findings.extend(serve_mod.check_serve())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -184,13 +196,14 @@ def run_check(
     sharded: Optional[bool] = None,
     compose: Optional[bool] = None,
     memory: Optional[bool] = None,
+    serve: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
     return run_check_detailed(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
         adaptive=adaptive, staleness=staleness, pipeline=pipeline,
-        sharded=sharded, compose=compose, memory=memory,
+        sharded=sharded, compose=compose, memory=memory, serve=serve,
     )[0]
 
 

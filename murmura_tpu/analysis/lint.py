@@ -148,6 +148,12 @@ RULES: Dict[str, str] = {
     "MUR1501": "sharded-memory-scaling",
     "MUR1502": "donation-completeness",
     "MUR1503": "overlap-dependence",
+    # 16xx = serving contracts (analysis/serve.py, `check --serve`;
+    # docs/ROBUSTNESS.md "Serving")
+    "MUR1600": "serve-bucket-key",
+    "MUR1601": "serve-admission-recompile",
+    "MUR1602": "serve-frozen-lane",
+    "MUR1603": "serve-resume-completeness",
 }
 
 

@@ -1,0 +1,350 @@
+"""MUR1600-1603: the serving contract (`murmura check --serve`;
+docs/ROBUSTNESS.md "Serving").
+
+The serve layer's whole pitch is that multiplexing experiments through
+shared compiled buckets changes NOTHING about their numbers.  Four
+executable probes, each on a tiny-but-real cell (5 nodes, an 83-param
+MLP, 2-3 rounds):
+
+- **MUR1600 — bucket-key soundness.**  Plan a small grid and re-derive
+  every cell's jaxpr skeleton INDEPENDENTLY (its own single-member
+  program, its own trace).  Two cells share a bucket ⇔ their skeletons
+  are structurally equal: every cell's trace must equal its bucket's,
+  and no two buckets may share a skeleton.  (The planner refuses
+  colliding classes loud — scheduler.plan_grid — so the ⇔ holds on
+  every grid that actually runs; this probe verifies the half the
+  refusal cannot: that the per-class representative trace speaks for
+  every member cell.)
+- **MUR1601 — zero recompiles across admissions.**  Run a warm bucket
+  through generation 1, then admit a NEW tenant set
+  (``reset_run(member_programs=...)``) and run generation 2 under
+  :class:`~murmura_tpu.analysis.sanitizers.CompileTracker`.  One compile
+  paid at bucket birth, zero forever after — a recompiling admission
+  would stall every co-tenant behind XLA.
+- **MUR1602 — frozen-lane non-interference.**  Freeze one member of a
+  two-member gang mid-run (the daemon's eviction); the survivor's
+  history must be byte-identical to a reference gang that never had the
+  neighbor at all (same compiled batch via ``min_batch``).  A vmap lane
+  can no more perturb its neighbor than a padding lane can — this probe
+  keeps that true as the lane machinery evolves.
+- **MUR1603 — resume completeness.**  Submit two tenants to an
+  in-process daemon, kill it mid-generation (after the first cadence
+  snapshot), rebuild a fresh daemon over the same ``state_dir``,
+  ``recover()``: every submission must reach a terminal state with a
+  history byte-identical to an uninterrupted reference daemon's.
+
+Executable and compile-bearing (like check_durability), so the sweep is
+memoized per process and runs by default only for the package-level
+check; tests gate representatives per tier-1 run
+(tests/test_serve_daemon.py) with negatives for each rule.
+"""
+
+import shutil
+import tempfile
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from murmura_tpu.analysis.durability import history_equal
+from murmura_tpu.analysis.lint import Finding
+
+# Registry of check families in this module: name -> callable, scanned by
+# analysis/ir.py's check_coverage so an unwired family is a MUR205
+# finding (the durability.py twin pattern).
+SERVE_CHECK_FAMILIES: Dict[str, Callable[[], List[Finding]]] = {}
+
+
+def _family(fn):
+    SERVE_CHECK_FAMILIES[fn.__name__] = fn
+    return fn
+
+
+def _anchor(rel_path: str, needle: str) -> Tuple[str, int]:
+    """Finding anchor: the line defining the machinery under contract."""
+    path = Path(__file__).resolve().parents[1] / rel_path
+    try:
+        for i, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            if needle in line:
+                return str(path), i
+    except OSError:
+        pass
+    return str(path), 1
+
+
+def _tenant_raw(seed: int, rounds: int = 3, rule: str = "fedavg") -> dict:
+    """One tenant/cell config dict — the durability._cell_config tiny
+    cell, parameterized by seed so daemon probes can submit several."""
+    return {
+        "experiment": {"name": f"serve-probe-{seed}", "seed": seed,
+                       "rounds": rounds},
+        "topology": {"type": "ring", "num_nodes": 5},
+        "aggregation": {"algorithm": rule},
+        "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 40, "input_shape": [6],
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 6, "hidden_dims": [8],
+                             "num_classes": 3}},
+        "backend": "simulation",
+    }
+
+
+def _grid_config():
+    from murmura_tpu.config import Config
+
+    return Config.model_validate({
+        **_tenant_raw(seed=7, rounds=2),
+        "grid": {"rules": ["fedavg", "median"], "attacks": ["gaussian"],
+                 "topologies": ["dense"], "strengths": [0.0, 1.0],
+                 "seeds": [7]},
+    })
+
+
+@_family
+def check_bucket_key_soundness() -> List[Finding]:
+    """MUR1600: same bucket ⇔ structurally equal skeletons, verified by
+    re-tracing every cell independently of the planner's representative."""
+    from murmura_tpu.config.schema import GridConfig
+    from murmura_tpu.serve.scheduler import cell_skeleton, plan_grid
+
+    path, line = _anchor("serve/scheduler.py", "def plan_grid")
+    config = _grid_config()
+    g = config.grid or GridConfig()
+    buckets = plan_grid(config, g)
+    findings: List[Finding] = []
+    seen: Dict[Tuple[str, ...], str] = {}
+    for bucket in buckets:
+        prior = seen.get(bucket.skeleton)
+        if prior is not None:
+            findings.append(Finding(
+                "MUR1600", path, line,
+                f"buckets {prior} and {bucket.key} carry structurally "
+                "equal skeletons — cells in different buckets must have "
+                "unequal skeletons (the planner's collision refusal is "
+                "broken)",
+            ))
+        seen[bucket.skeleton] = bucket.key
+        for cell in bucket.cells:
+            independent = cell_skeleton(config, g, cell)
+            if independent != bucket.skeleton:
+                findings.append(Finding(
+                    "MUR1600", path, line,
+                    f"cell {cell.cell_id} traces a skeleton different "
+                    f"from its bucket {bucket.key}'s — the per-class "
+                    "representative does not speak for this cell, so the "
+                    "bucket would hide a recompile (or worse, run the "
+                    "wrong math)",
+                ))
+    return findings
+
+
+@_family
+def check_admission_recompile() -> List[Finding]:
+    """MUR1601: generation 2 admitted into a warm bucket compiles
+    nothing."""
+    from murmura_tpu.analysis.sanitizers import track_compiles
+    from murmura_tpu.config import Config
+    from murmura_tpu.core.gang import GangMember
+    from murmura_tpu.utils.factories import (
+        build_gang_from_config,
+        build_gang_member_programs,
+    )
+
+    path, line = _anchor("core/gang.py", "def _admit_members")
+    raw = _tenant_raw(seed=7, rounds=2)
+    raw["sweep"] = {"members": [{"seed": 7, "lr": 0.05}]}
+    template = Config.model_validate(raw)
+    gang = build_gang_from_config(template, min_batch=4)
+    gang.train(rounds=2, eval_every=1)  # generation 1: pays the compile
+
+    findings: List[Finding] = []
+    gen2 = [GangMember(seed=21, lr=0.05), GangMember(seed=22, lr=0.02)]
+    progs = []
+    for m in gen2:
+        t_raw = _tenant_raw(seed=m.seed, rounds=2)
+        t_raw["training"]["lr"] = m.lr
+        progs.append(build_gang_member_programs(
+            Config.model_validate(t_raw), [m]
+        )[0])
+    with track_compiles() as tracker:
+        gang.reset_run(gen2, member_programs=progs)
+        gang.train(rounds=2, eval_every=1)
+    if tracker.total:
+        findings.append(Finding(
+            "MUR1601", path, line,
+            f"admitting generation 2 into a warm bucket compiled "
+            f"{tracker.total} program(s) — admission must be a value-only "
+            "splice into the frozen lanes (fixed [B, ...] shapes via "
+            "min_batch); a recompiling admission stalls every co-tenant",
+        ))
+    return findings
+
+
+@_family
+def check_frozen_lane_interference() -> List[Finding]:
+    """MUR1602: freezing a lane mid-run leaves the survivor's history
+    byte-identical to a gang that never had the neighbor."""
+    from murmura_tpu.config import Config
+    from murmura_tpu.utils.factories import build_gang_from_config
+
+    path, line = _anchor("core/gang.py", "def freeze_member")
+    raw = _tenant_raw(seed=7, rounds=3)
+    raw["sweep"] = {"members": [{"seed": 7, "lr": 0.05},
+                                {"seed": 8, "lr": 0.05}]}
+    pair = build_gang_from_config(Config.model_validate(raw))
+    pair.train(rounds=1, eval_every=1)
+    pair.freeze_member(1, "mur1602-probe")
+    pair.train(rounds=2, eval_every=1)
+
+    solo_raw = _tenant_raw(seed=7, rounds=3)
+    solo_raw["sweep"] = {"members": [{"seed": 7, "lr": 0.05}]}
+    # min_batch matches the pair gang's compiled batch, so the survivor
+    # and the reference run the SAME program shape — lane count is the
+    # only difference under test.
+    solo = build_gang_from_config(
+        Config.model_validate(solo_raw), min_batch=2,
+    )
+    solo.train(rounds=3, eval_every=1)
+
+    findings: List[Finding] = []
+    if not history_equal(pair.histories[0], solo.histories[0]):
+        diverged = sorted(
+            k for k in set(pair.histories[0]) | set(solo.histories[0])
+            if not history_equal(
+                pair.histories[0].get(k), solo.histories[0].get(k)
+            )
+        )
+        findings.append(Finding(
+            "MUR1602", path, line,
+            f"survivor history diverges from the unadmitted reference in "
+            f"{diverged} after freezing the neighbor lane — eviction must "
+            "not perturb co-tenants (a frozen lane is a padding lane)",
+        ))
+    frozen_len = len(pair.histories[1].get("round", []))
+    if frozen_len > 1:
+        findings.append(Finding(
+            "MUR1602", path, line,
+            f"frozen lane kept recording ({frozen_len} rounds after a "
+            "freeze at round 1) — freeze_member must stop the lane's "
+            "history at the freeze round",
+        ))
+    return findings
+
+
+@_family
+def check_resume_completeness() -> List[Finding]:
+    """MUR1603: kill the daemon mid-generation, recover a fresh one from
+    the same state_dir — every submission terminal, histories
+    byte-identical to an uninterrupted reference daemon."""
+    import murmura_tpu.core.gang as gang_mod
+    from murmura_tpu.config import Config
+    from murmura_tpu.serve.daemon import TERMINAL_STATES, ServeDaemon
+
+    path, line = _anchor("serve/daemon.py", "def recover")
+    findings: List[Finding] = []
+    tmp = Path(tempfile.mkdtemp(prefix="murmura-serve-check-"))
+    try:
+        def daemon(state: Path) -> ServeDaemon:
+            cfg = Config.model_validate({
+                **_tenant_raw(seed=0, rounds=3),
+                "serve": {"state_dir": str(state), "capacity": 2,
+                          "checkpoint_every": 1},
+            })
+            return ServeDaemon(cfg)
+
+        ref = daemon(tmp / "ref")
+        ref.submit_config(_tenant_raw(seed=5))
+        ref.submit_config(_tenant_raw(seed=6))
+        ref.drain()
+
+        victim = daemon(tmp / "crash")
+        victim.submit_config(_tenant_raw(seed=5))
+        victim.submit_config(_tenant_raw(seed=6))
+
+        class _Kill(BaseException):
+            """Out-of-band like a real SIGKILL: no handler catches it."""
+
+        orig_train = gang_mod.GangNetwork.train
+        def dying_train(self, rounds, **kw):
+            orig_train(self, rounds=1, **kw)  # round 1 + cadence snapshot
+            raise _Kill()
+        gang_mod.GangNetwork.train = dying_train
+        try:
+            victim.drain()
+        except _Kill:
+            pass
+        finally:
+            gang_mod.GangNetwork.train = orig_train
+        del victim  # the process is gone; only state_dir survives
+
+        revived = daemon(tmp / "crash")
+        revived.recover()
+        revived.drain()
+
+        for (rid, ref_rec), (vid, rec) in zip(
+            sorted(ref._ledger.items()), sorted(revived._ledger.items())
+        ):
+            if rec["state"] not in TERMINAL_STATES:
+                findings.append(Finding(
+                    "MUR1603", path, line,
+                    f"submission {vid} is still '{rec['state']}' after "
+                    "daemon kill + recover + drain — every submitted run "
+                    "must reach a terminal state",
+                ))
+                continue
+            if rec["state"] != "done":
+                findings.append(Finding(
+                    "MUR1603", path, line,
+                    f"submission {vid} recovered to '{rec['state']}' "
+                    f"({rec.get('error')}) — the interrupted generation "
+                    "did not resume",
+                ))
+                continue
+            if not history_equal(rec.get("history"), ref_rec.get("history")):
+                findings.append(Finding(
+                    "MUR1603", path, line,
+                    f"submission {vid} resumed to a history diverging "
+                    f"from the uninterrupted reference {rid} — the "
+                    "recovered generation is not crash-equivalent "
+                    "(MUR901 machinery broken on the serve path)",
+                ))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Entry point
+# --------------------------------------------------------------------------
+
+_SERVE_MEMO: Optional[List[Finding]] = None
+
+
+def check_serve(force: bool = False) -> List[Finding]:
+    """Run MUR1600-1603; returns findings (empty = bucketing is sound,
+    admissions never recompile, eviction never perturbs survivors, and a
+    killed daemon completes everything it accepted).  Memoized per
+    process; compile-bearing, so it runs by default only for the
+    package-level check (like check_durability)."""
+    global _SERVE_MEMO
+    if _SERVE_MEMO is not None and not force:
+        return list(_SERVE_MEMO)
+
+    from murmura_tpu.analysis.ir import _apply_suppressions
+
+    findings: List[Finding] = []
+    for fam_name, fam in SERVE_CHECK_FAMILIES.items():
+        try:
+            findings.extend(fam())
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(Finding(
+                "MUR1600", str(Path(__file__).resolve()), 1,
+                f"serve check family '{fam_name}' crashed: "
+                f"{type(e).__name__}: {e}",
+            ))
+    findings = _apply_suppressions(list(dict.fromkeys(findings)))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _SERVE_MEMO = list(findings)
+    return findings

@@ -576,6 +576,184 @@ def frontier(config_path: Path, output, device, require_tpu):
     return artifact
 
 
+@app.command()
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--output", "-o", type=click.Path(path_type=Path),
+              default=Path("grid.json"), show_default=True,
+              help="Write the cross-cell grid manifest here")
+@click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
+              help="Force the JAX platform")
+@click.option("--require-tpu", is_flag=True, default=False,
+              help="Abort loudly unless the default JAX backend is a TPU")
+@click.option("--plan-only", is_flag=True, default=False,
+              help="Print the bucket plan (cells per compile-compatible "
+                   "bucket) without executing anything")
+def grid(config_path: Path, output, device, require_tpu, plan_only):
+    """Run the config's rule x attack x topology x strength x seed grid
+    through the compile-compatible scheduler (docs/ROBUSTNESS.md
+    "Serving").
+
+    Cells are partitioned into buckets by their traced jaxpr skeleton
+    (the MUR203/MUR500 structural-equality key): cells share a bucket iff
+    their programs are structurally equal, each bucket runs as ONE gang
+    on the fused dispatch path — one compile per bucket, counted by
+    CompileTracker and recorded in the manifest — and strength/seed
+    become traced member inputs.  The full README grid (5 rules x
+    gaussian x 5 strengths x 2 seeds = 50 cells) executes in 5 compiles.
+    Render the manifest with `murmura report --grid`.
+    """
+    if device is not None:
+        # Must land before anything initializes the XLA backend.
+        import jax
+
+        jax.config.update("jax_platforms", device)
+    config = _load_config_or_die(config_path)
+    _enforce_require_tpu(config, require_tpu)
+    from murmura_tpu.serve.scheduler import plan_grid, run_grid, write_grid
+    from murmura_tpu.utils.factories import ConfigError
+
+    g = config.grid
+    grid_desc = (
+        f"{g.rules} x {g.attacks} x {g.topologies}" if g is not None
+        else "default grid"
+    )
+    console.print(
+        f"[bold cyan]murmura_tpu[/bold cyan] grid "
+        f"[bold]{config.experiment.name}[/bold] "
+        f"(nodes={config.topology.num_nodes}, {escape(grid_desc)})"
+    )
+    try:
+        if plan_only:
+            buckets = plan_grid(config)
+            for b in buckets:
+                console.print(
+                    f"  bucket [bold]{b.key}[/bold] "
+                    f"{b.rule} x {b.attack} x {b.topology}: "
+                    f"{len(b.cells)} cells"
+                )
+            console.print(
+                f"{sum(len(b.cells) for b in buckets)} cells in "
+                f"{len(buckets)} buckets = {len(buckets)} compiles"
+            )
+            return
+        artifact = run_grid(
+            config, progress=lambda s: console.print(f"[dim]{escape(s)}[/dim]")
+        )
+    except ConfigError as e:
+        _die_config_error(e)
+    path = write_grid(artifact, output)
+    console.print(
+        f"Grid manifest written to [bold]{path}[/bold] "
+        f"({artifact['total_cells']} cells, "
+        f"{artifact['total_compiles']} compiles)"
+    )
+    from murmura_tpu.telemetry.report import render_grid
+
+    render_grid(artifact, console=console)
+    return artifact
+
+
+@app.command()
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--device", type=click.Choice(["cpu", "tpu"]), default=None,
+              help="Force the JAX platform")
+@click.option("--require-tpu", is_flag=True, default=False,
+              help="Abort loudly unless the default JAX backend is a TPU")
+def serve(config_path: Path, device, require_tpu):
+    """Crash-surviving multi-tenant experiment daemon
+    (docs/ROBUSTNESS.md "Serving").
+
+    Accepts experiment submissions over a local unix socket (`murmura
+    submit`), multiplexes structurally-equal submissions onto warm
+    compiled gang buckets (power-of-two growth via ``serve.capacity``;
+    admissions are value-only splices — zero recompiles, MUR1601),
+    checkpoints every tenant on the ``serve.checkpoint_every`` cadence,
+    and survives SIGKILL: on restart every in-flight run resumes from
+    its snapshot byte-identically (MUR1603).  State lives under
+    ``serve.state_dir``; re-running this command over the same state
+    dir IS the recovery path.
+    """
+    if device is not None:
+        # Must land before anything initializes the XLA backend.
+        import jax
+
+        jax.config.update("jax_platforms", device)
+    config = _load_config_or_die(config_path)
+    _enforce_require_tpu(config, require_tpu)
+    from murmura_tpu.serve.daemon import ServeDaemon
+    from murmura_tpu.utils.factories import ConfigError
+
+    try:
+        daemon = ServeDaemon(config)
+    except (ConfigError, ValueError) as e:
+        _die_config_error(e)
+    console.print(
+        f"[bold cyan]murmura_tpu[/bold cyan] serve: listening on "
+        f"[bold]{daemon.socket_path}[/bold] "
+        f"(state_dir={daemon.state_dir}, capacity={daemon.capacity})"
+    )
+    try:
+        daemon.serve_forever()
+    except KeyboardInterrupt:
+        daemon.close()
+    console.print("murmura serve: stopped")
+
+
+@app.command()
+@click.argument("config_path", type=click.Path(exists=True, path_type=Path))
+@click.option("--socket", "socket_path", required=True,
+              type=click.Path(path_type=Path),
+              help="The daemon's unix socket (serve.socket / "
+                   "<state_dir>/daemon.sock)")
+@click.option("--wait/--no-wait", default=False,
+              help="Block until the submission reaches a terminal state "
+                   "and print its final record")
+@click.option("--poll-s", type=float, default=0.5, show_default=True,
+              help="Status poll interval with --wait")
+def submit(config_path: Path, socket_path, wait, poll_s):
+    """Submit one experiment to a running `murmura serve` daemon.
+
+    The submitted yaml is a plain single-experiment config (no sweep/
+    frontier/grid/serve sections — the daemon owns multiplexing).
+    Submissions whose configs differ only in seed / name / lr share one
+    warm compiled bucket.  Socket-layer failures (a daemon mid-restart)
+    are classified transient and retried with backoff
+    (durability/dispatch.py).
+    """
+    import time as _time
+
+    import yaml
+
+    from murmura_tpu.serve.protocol import send_request
+
+    with open(config_path, encoding="utf-8") as fh:
+        raw = yaml.safe_load(fh)
+    resp = send_request(str(socket_path), {"op": "submit", "config": raw})
+    if not resp.get("ok"):
+        console.print(f"[bold red]{escape(str(resp.get('error')))}[/bold red]")
+        raise SystemExit(1)
+    console.print(
+        f"submitted [bold]{resp['id']}[/bold] "
+        f"(bucket {resp['bucket']})"
+    )
+    if not wait:
+        return resp
+    while True:
+        st = send_request(
+            str(socket_path), {"op": "status", "id": resp["id"]},
+        )
+        sub = st.get("submission", {})
+        if sub.get("state") in ("done", "failed", "evicted"):
+            console.print(
+                f"[bold]{resp['id']}[/bold] {sub['state']} "
+                f"(final_accuracy={sub.get('final_accuracy')})"
+            )
+            if sub.get("state") != "done":
+                raise SystemExit(1)
+            return sub
+        _time.sleep(poll_s)
+
+
 @app.command("run-node")
 @click.argument("config_path", type=click.Path(exists=True, path_type=Path))
 @click.option("--node-id", type=int, required=True, help="This worker's node id")
@@ -692,6 +870,17 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "for the package check, off when explicit PATHS are given.",
 )
 @click.option(
+    "--serve/--no-serve", "serve_checks", default=None,
+    help="Run the serving contracts (MUR1600-1603: bucket-key soundness "
+         "— same scheduler bucket ⇔ structurally equal independently-"
+         "traced jaxpr skeletons — zero recompiles across warm-bucket "
+         "admissions, frozen-lane non-interference under eviction, "
+         "daemon kill+recover resume completeness with byte-identical "
+         "histories).  Compiles and runs tiny gangs plus an in-process "
+         "daemon (~1 min on CPU).  Default: on for the package check, "
+         "off when explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary / "
          "compose-summary / memory-summary records) as JSON lines for "
@@ -708,8 +897,8 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "analysis/MEMORY.json; review the diff as residency history.",
 )
 def check(paths, contracts, ir, flow, durability, adaptive, staleness,
-          pipeline, sharded, compose, memory, as_json, update_budgets,
-          update_memory):
+          pipeline, sharded, compose, memory, serve_checks, as_json,
+          update_budgets, update_memory):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -725,8 +914,9 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     bounded-staleness contracts (MUR1100-1103 via --staleness), the
     pipelined-rounds contracts (MUR1200-1203 via --pipeline), the
     param-axis sharding contracts (MUR1300-1303 via --sharded), the
-    cross-feature composition grid (MUR1400-1403 via --compose), and the
-    static memory contracts (MUR1500-1503 via --memory).
+    cross-feature composition grid (MUR1400-1403 via --compose), the
+    static memory contracts (MUR1500-1503 via --memory), and the serving
+    contracts (MUR1600-1603 via --serve).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -759,6 +949,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
         durability=durability, adaptive=adaptive, staleness=staleness,
         pipeline=pipeline, sharded=sharded, compose=compose, memory=memory,
+        serve=serve_checks,
     )
     if as_json:
         out = format_findings_json(findings, records)
@@ -791,14 +982,23 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
          "plus each cell's honest-accuracy curve over attack strength.",
 )
 @click.option(
+    "--grid", "grid_path", default=None,
+    type=click.Path(exists=True, dir_okay=False, path_type=Path),
+    help="Render a grid.json manifest (`murmura grid`) instead of a "
+         "telemetry run directory: cells per compile-compatible bucket "
+         "with per-bucket compile counts, and per-cell accuracy / "
+         "phase-time accounting.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit the report as one JSON object (machine-readable; the same "
          "dict the tables render) instead of rich tables.",
 )
 def report(run_dir: Optional[Path], frontier_path: Optional[Path],
-           as_json: bool):
+           grid_path: Optional[Path], as_json: bool):
     """Render a telemetry run directory (manifest.json + events.jsonl),
-    or — with ``--frontier`` — a frontier artifact.
+    or — with ``--frontier`` / ``--grid`` — a frontier artifact or a
+    grid scheduler manifest.
 
     Works on any producer's output — a `murmura_tpu run` with
     ``telemetry.enabled``, a distributed run's Monitor-folded manifest, or
@@ -828,10 +1028,29 @@ def report(run_dir: Optional[Path], frontier_path: Optional[Path],
         else:
             render_frontier(artifact, console=console)
         return
+    if grid_path is not None:
+        from murmura_tpu.serve.scheduler import load_grid
+        from murmura_tpu.telemetry.report import render_grid
+
+        try:
+            artifact = load_grid(grid_path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            console.print(f"[bold red]{escape(str(e))}[/bold red]")
+            raise SystemExit(1)
+        if as_json:
+            click.echo(json.dumps({
+                "grid": artifact.get("grid"),
+                "buckets": artifact.get("buckets"),
+                "total_cells": artifact.get("total_cells"),
+                "total_compiles": artifact.get("total_compiles"),
+            }))
+        else:
+            render_grid(artifact, console=console)
+        return
     if run_dir is None:
         console.print(
             "[bold red]murmura report needs a RUN_DIR (or "
-            "--frontier <frontier.json>)[/bold red]"
+            "--frontier <frontier.json> / --grid <grid.json>)[/bold red]"
         )
         raise SystemExit(1)
     from murmura_tpu.telemetry.report import build_report, render_report

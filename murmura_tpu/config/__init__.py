@@ -8,8 +8,10 @@ from murmura_tpu.config.schema import (
     DistributedConfig,
     DMTTConfig,
     ExperimentConfig,
+    GridConfig,
     MobilityConfig,
     ModelConfig,
+    ServeConfig,
     SweepConfig,
     SweepMemberConfig,
     TopologyConfig,
@@ -33,6 +35,8 @@ __all__ = [
     "TPUConfig",
     "SweepConfig",
     "SweepMemberConfig",
+    "GridConfig",
+    "ServeConfig",
     "load_config",
     "save_config",
 ]

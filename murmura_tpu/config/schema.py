@@ -799,6 +799,144 @@ class FrontierConfig(_Strict):
         return self
 
 
+class GridConfig(_Strict):
+    """`murmura grid <yaml>`: the compile-compatible grid scheduler
+    (serve/scheduler.py; docs/ROBUSTNESS.md "Serving").
+
+    Expands a rule x attack x topology x strength x seed cell set and
+    partitions it into **buckets keyed by the traced round program's
+    jaxpr skeleton** (analysis/ir.py ``jaxpr_signature`` — the MUR203/
+    MUR500 structural-equality machinery): cells whose programs are
+    structurally equal share ONE gang bucket and therefore ONE compile;
+    strength and seed ride as traced inputs (``attack_scale`` / the RNG
+    lane) inside a bucket.  The full grid executes back-to-back off the
+    warm compile cache and emits one cross-cell manifest for
+    ``murmura report --grid``.
+    """
+
+    rules: List[str] = Field(
+        default=["krum", "median", "trimmed_mean", "balance", "fedavg"],
+        description="Aggregation rules (one bucket per rule, typically)",
+    )
+    attacks: List[Literal["gaussian", "alie", "ipm", "none"]] = Field(
+        default=["gaussian"],
+        description=(
+            "Attack types per cell; 'none' runs benign cells (their "
+            "program has no perturbation ops, so they bucket separately)"
+        ),
+    )
+    topologies: List[Literal["dense", "sparse"]] = Field(
+        default=["dense"],
+        description=(
+            "'dense' = the config's own (dense) topology; 'sparse' = the "
+            "degree-log(N) exponential graph"
+        ),
+    )
+    strengths: List[float] = Field(
+        default=[0.0, 0.5, 1.0, 2.0, 4.0],
+        description=(
+            "Attack-strength axis (attack_scale units; 0.0 = the benign "
+            "reference member).  A traced input — strengths share a "
+            "bucket's single compile.  Ignored for attacks: ['none']"
+        ),
+    )
+    seeds: Optional[List[int]] = Field(
+        default=None,
+        description=(
+            "Member seeds per strength (default: [experiment.seed, "
+            "experiment.seed + 1])"
+        ),
+    )
+    rounds: Optional[int] = Field(
+        default=None, ge=1,
+        description="Training rounds per cell (default: experiment.rounds)",
+    )
+
+    @model_validator(mode="after")
+    def _grid_sane(self):
+        for fieldname in ("rules", "attacks", "topologies", "strengths"):
+            vals = getattr(self, fieldname)
+            if not vals:
+                raise ValueError(f"grid.{fieldname} must be non-empty")
+            if len(vals) != len(set(vals)):
+                raise ValueError(f"grid.{fieldname} has duplicates: {vals}")
+        if self.seeds is not None:
+            if not self.seeds:
+                raise ValueError("grid.seeds must be non-empty")
+            if len(self.seeds) != len(set(self.seeds)):
+                raise ValueError("grid.seeds must be distinct")
+        bad = [g for g in self.strengths if g < 0.0]
+        if bad:
+            raise ValueError(f"grid.strengths must be >= 0, got {bad}")
+        return self
+
+
+class ServeConfig(_Strict):
+    """`murmura serve <yaml>`: the crash-surviving multi-tenant daemon
+    (serve/daemon.py; docs/ROBUSTNESS.md "Serving").
+
+    The daemon accepts experiment submissions over a local socket and
+    admits them into **warm gang buckets** keyed by the submission's
+    structural fingerprint: tenants whose configs differ only in
+    ``experiment.seed`` / ``experiment.name`` / ``training.lr`` (traced
+    inputs) share one compiled bucket, admitted generation-by-generation
+    via value-only ``GangNetwork.reset_run`` — zero recompiles
+    (MUR1601).  Every bucket is built at ``capacity`` lanes up front
+    (the power-of-two ``next_bucket`` shape), so admission never changes
+    the compile shape; the queue simply waits for the next generation
+    when more than ``capacity`` tenants target one bucket.  All daemon
+    state (the submission ledger, generation records, gang snapshots on
+    ``checkpoint_every`` cadence) lives under ``state_dir`` through the
+    fsync'd durable-replace path, so a SIGKILL'd daemon restarts and
+    resumes every in-flight run byte-identically (MUR1603).
+    """
+
+    state_dir: str = Field(
+        description=(
+            "Daemon state root: submission ledger + generation records + "
+            "per-bucket gang snapshots (all fsync'd durable writes)"
+        ),
+    )
+    socket: Optional[str] = Field(
+        default=None,
+        description=(
+            "Unix-domain socket path for submissions (default: "
+            "<state_dir>/daemon.sock)"
+        ),
+    )
+    capacity: int = Field(
+        default=4, ge=1,
+        description=(
+            "Gang lanes per bucket (power of two — the next_bucket "
+            "compile shape).  Buckets are built at full capacity so "
+            "within-capacity admission is value-only; a larger tenant "
+            "backlog waits for the next generation instead of growing "
+            "the compiled shape"
+        ),
+    )
+    checkpoint_every: int = Field(
+        default=1, ge=1,
+        description=(
+            "Gang snapshot cadence in rounds (durability/snapshot.py) — "
+            "the resume granularity after a daemon SIGKILL"
+        ),
+    )
+    poll_interval_s: float = Field(
+        default=0.05, gt=0.0,
+        description="Scheduler idle-poll interval between generations",
+    )
+
+    @model_validator(mode="after")
+    def _capacity_is_bucket(self):
+        c = self.capacity
+        if c & (c - 1):
+            raise ValueError(
+                f"serve.capacity={c} must be a power of two — it IS the "
+                "gang's next_bucket compile shape"
+            )
+        return self
+
+
 class TrainingConfig(_Strict):
     """Local training hyperparameters (reference: murmura/config/schema.py:142-150)."""
 
@@ -1081,6 +1219,24 @@ class Config(_Strict):
             "attack x topology breaking-point curves; docs/ROBUSTNESS.md); "
             "absent => byte-identical behavior (only the frontier command "
             "reads it)"
+        ),
+    )
+    grid: Optional[GridConfig] = Field(
+        default=None,
+        description=(
+            "`murmura grid` compile-compatible scheduler grid (rule x "
+            "attack x topology cells partitioned into jaxpr-skeleton "
+            "buckets; docs/ROBUSTNESS.md \"Serving\"); absent => "
+            "byte-identical behavior (only the grid command reads it)"
+        ),
+    )
+    serve: Optional[ServeConfig] = Field(
+        default=None,
+        description=(
+            "`murmura serve` multi-tenant daemon settings (state dir, "
+            "socket, bucket capacity, checkpoint cadence; "
+            "docs/ROBUSTNESS.md \"Serving\"); absent => byte-identical "
+            "behavior (only the serve command reads it)"
         ),
     )
 

@@ -245,6 +245,7 @@ class GangNetwork:
         transfer_guard: bool = False,
         telemetry_writers: Optional[Sequence] = None,
         retain_init: bool = False,
+        min_batch: int = 1,
     ):
         if len(member_programs) != len(members):
             raise ValueError("one RoundProgram per member required")
@@ -271,7 +272,13 @@ class GangNetwork:
         self.program = program
         self.members = list(members)
         self.gang_size = len(members)
-        self.batch = next_bucket(self.gang_size) if bucket else self.gang_size
+        # min_batch pre-grows the compile shape (serve/daemon.py: a bucket
+        # built at full capacity admits tenants value-only — the shape
+        # never changes, so admission never recompiles).
+        self.batch = (
+            next_bucket(max(self.gang_size, min_batch))
+            if bucket else self.gang_size
+        )
         self.topology = topology
         self.attack = attack
         self.mobility = mobility
@@ -787,20 +794,49 @@ class GangNetwork:
         if active is not None and len(active) == self.gang_size:
             self.member_active = [bool(a) for a in active]
 
-    def reset_run(self, members: Sequence[GangMember]) -> None:
-        """Value-only reset for a fresh run of the SAME gang shape with
-        new traced-scalar hyperparameters — the `murmura frontier` stage
-        loop (frontier.py): params/agg_state/RNG/histories return to
-        round 0 while the warm compiled programs (and their jit caches)
-        are untouched, so the next train() costs ZERO recompiles.
+    def reset_run(
+        self,
+        members: Sequence[GangMember],
+        member_programs: Optional[Sequence[RoundProgram]] = None,
+        telemetry_writers: Optional[Sequence] = None,
+    ) -> None:
+        """Value-only reset for a fresh run over the SAME warm compiled
+        programs — zero recompiles on the next train().
 
-        Constraints, each fail-loud: the gang must have been built with
-        ``retain_init=True`` (the stacked host init arrays are the reset
-        source), the new member list must be slot-for-slot the same
-        seeds (data shards and init params were built per ORIGINAL seed
-        — changing a seed silently trains on the wrong shard), and only
-        traced-input overrides (lr / attack_scale) may differ.
+        Two modes:
+
+        - **Stage reset** (``member_programs=None`` — the `murmura
+          frontier` stage loop): params/agg_state/RNG/histories return
+          to round 0 from the retained host init arrays.  Constraints,
+          each fail-loud: the gang must have been built with
+          ``retain_init=True``, the new member list must be
+          slot-for-slot the same seeds (data shards and init params
+          were built per ORIGINAL seed), and only traced-input
+          overrides (lr / attack_scale) may differ.
+        - **Re-tenanting** (``member_programs`` given — the `murmura
+          serve` admission path, docs/ROBUSTNESS.md "Serving"): each
+          lane is spliced host-side with a NEW member's init params /
+          agg state / data shards / RNG base from its own
+          ``build_round_program`` output.  New seeds are allowed
+          (the programs carry the per-seed values); the member count
+          may be anything in ``1..batch`` (padding lanes replicate
+          member 0, exactly like construction); duplicate labels are
+          allowed (serve tenants are identified by submission id, not
+          label).  The compiled executables are untouched — the new
+          programs contribute VALUES only and are never traced, so
+          every admitted tenant still runs member 0's traced math,
+          which ``_check_member_compatible`` requires to be
+          gang-batchable with the template's.
         """
+        if member_programs is not None:
+            self._admit_members(members, member_programs, telemetry_writers)
+            return
+        if telemetry_writers is not None:
+            raise ValueError(
+                "reset_run(telemetry_writers=...) is only meaningful on "
+                "the re-tenanting path (member_programs given) — a stage "
+                "reset keeps the gang's writers"
+            )
         if self._init_params_host is None:
             raise ValueError(
                 "reset_run() needs the gang built with retain_init=True "
@@ -856,6 +892,92 @@ class GangNetwork:
             [jax.random.PRNGKey(members[i].seed) for i in self._indices]
         )
         self._place_resident_state()
+        self.histories = [empty_history() for _ in range(self.gang_size)]
+        self._last_stats = [{} for _ in range(self.gang_size)]
+        self.round_times = []
+        self.current_round = 0
+        self.member_active = [True] * self.gang_size
+
+    def _admit_members(
+        self,
+        members: Sequence[GangMember],
+        member_programs: Sequence[RoundProgram],
+        telemetry_writers: Optional[Sequence],
+    ) -> None:
+        """The re-tenanting half of :meth:`reset_run` (serve/daemon.py):
+        splice a new generation of tenants into the warm bucket's lanes
+        — values only, the compiled [B, ...] executables never change
+        shape (B = self.batch is fixed at construction; min_batch
+        pre-grows it to the bucket's capacity)."""
+        members = list(members)
+        progs = list(member_programs)
+        if len(progs) != len(members):
+            raise ValueError("one RoundProgram per admitted member required")
+        if not 1 <= len(members) <= self.batch:
+            raise ValueError(
+                f"cannot admit {len(members)} members into a bucket of "
+                f"batch {self.batch} — the compiled shape is fixed; a "
+                "larger tenant set needs a bigger bucket (a new compile)"
+            )
+        # The admitted programs are value sources for member 0's traced
+        # math — the same batchability contract construction enforces.
+        # The slot-0 member in the probe list is unused by the checker.
+        _check_member_compatible(
+            [self.program, *progs], [self.members[0], *members]
+        )
+        self.members = members
+        self.gang_size = len(members)
+        self._indices = list(range(self.gang_size)) + [0] * (
+            self.batch - self.gang_size
+        )
+        stack = lambda get: _stack_trees(  # noqa: E731
+            [get(p) for p in progs], self._indices
+        )
+        init_params_host = stack(lambda p: p.init_params)
+        init_agg_host = stack(lambda p: p.init_agg_state)
+        if self._init_params_host is not None:
+            # Keep the stage-reset source coherent with the new tenants
+            # (a frontier-style reset after an admission must reset to
+            # the ADMITTED generation's init, not a stale one's).
+            self._init_params_host = init_params_host
+            self._init_agg_host = init_agg_host
+        self.params = jax.tree_util.tree_map(jnp.asarray, init_params_host)
+        self.agg_state = {
+            k: jnp.asarray(v) for k, v in init_agg_host.items()
+        }
+        data = stack(lambda p: p.data_arrays)
+        if "lr" in self.program.hp_inputs:
+            data["hp_lr"] = np.asarray(
+                [
+                    members[i].lr if members[i].lr is not None
+                    else self._base_lr
+                    for i in self._indices
+                ],
+                np.float32,
+            )
+        if "attack_scale" in self.program.hp_inputs:
+            data["hp_attack_scale"] = np.asarray(
+                [
+                    members[i].attack_scale
+                    if members[i].attack_scale is not None
+                    else 1.0
+                    for i in self._indices
+                ],
+                np.float32,
+            )
+        self._data = {k: jnp.asarray(v) for k, v in data.items()}
+        self._rng = jnp.stack(
+            [jax.random.PRNGKey(members[i].seed) for i in self._indices]
+        )
+        self._place_resident_state()
+        if telemetry_writers is not None:
+            self.telemetry = list(telemetry_writers)
+        if self.telemetry and len(self.telemetry) != self.gang_size:
+            raise ValueError(
+                f"{len(self.telemetry)} telemetry writers for "
+                f"{self.gang_size} admitted members — pass one writer per "
+                "member (or an empty list) when re-tenanting"
+            )
         self.histories = [empty_history() for _ in range(self.gang_size)]
         self._last_stats = [{} for _ in range(self.gang_size)]
         self.round_times = []

@@ -23,6 +23,7 @@ envelope gives every long-lived driver (CLI runs, the battery, a future
   not a TPU, instead of producing CPU numbers labeled by hope.
 """
 
+import errno
 import os
 import random
 import time
@@ -52,10 +53,32 @@ TRANSIENT_ERROR_MARKERS = (
     "transport",
     "tunnel",
     "heartbeat",
+    "address already in use",
 )
 
 # Exception types that are transient by construction (transport layer).
+# ConnectionResetError / BrokenPipeError / ConnectionRefusedError are
+# ConnectionError subclasses and socket.timeout aliases TimeoutError, so
+# the daemon's socket layer (serve/protocol.py) is covered wholesale.
 TRANSIENT_ERROR_TYPES = (ConnectionError, TimeoutError)
+
+# OSError errnos that mark a socket-layer transient even when the
+# exception is a bare OSError (no ConnectionError subclass): a killed
+# daemon's stale socket file (EADDRINUSE on rebind), a peer that died
+# mid-write, a refused/aborted connect during restart.
+TRANSIENT_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "EADDRINUSE",
+        "ECONNRESET",
+        "ECONNREFUSED",
+        "ECONNABORTED",
+        "EPIPE",
+        "ETIMEDOUT",
+        "EAGAIN",
+    )
+    if hasattr(errno, name)
+)
 
 
 def classify_error(exc: BaseException) -> str:
@@ -67,6 +90,11 @@ def classify_error(exc: BaseException) -> str:
     if isinstance(exc, BackendRequirementError):
         return "fatal"
     if isinstance(exc, TRANSIENT_ERROR_TYPES):
+        return "transient"
+    if (
+        isinstance(exc, OSError)
+        and getattr(exc, "errno", None) in TRANSIENT_ERRNOS
+    ):
         return "transient"
     text = f"{type(exc).__name__}: {exc}".lower()
     if any(marker in text for marker in TRANSIENT_ERROR_MARKERS):

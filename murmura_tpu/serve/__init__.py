@@ -1,0 +1,45 @@
+"""Serving layer (ISSUE 18; docs/ROBUSTNESS.md "Serving"): the
+compile-compatible grid scheduler (`murmura grid`) and the
+crash-surviving multi-tenant daemon (`murmura serve` / `murmura submit`).
+
+Both legs stand on the same invariant: a config's trace-relevant content
+(its structural fingerprint / jaxpr skeleton) decides which compiled
+bucket can run it, and everything else — seed, lr, attack strength — is
+a traced input spliced into warm lanes.  Contracted as MUR1600-1603
+(analysis/serve.py, in the default `murmura check` package gate).
+"""
+
+from murmura_tpu.serve.scheduler import (
+    GridBucket,
+    GridCell,
+    expand_cells,
+    load_grid,
+    plan_grid,
+    program_skeleton,
+    run_grid,
+    structural_fingerprint,
+    write_grid,
+)
+from murmura_tpu.serve.daemon import (
+    ServeDaemon,
+    SubmissionError,
+    normalize_submission,
+)
+from murmura_tpu.serve.protocol import ServerSocket, send_request
+
+__all__ = [
+    "GridBucket",
+    "GridCell",
+    "expand_cells",
+    "load_grid",
+    "plan_grid",
+    "program_skeleton",
+    "run_grid",
+    "structural_fingerprint",
+    "write_grid",
+    "ServeDaemon",
+    "SubmissionError",
+    "normalize_submission",
+    "ServerSocket",
+    "send_request",
+]

@@ -1,0 +1,444 @@
+"""`murmura grid <yaml>`: the compile-compatible grid scheduler
+(ISSUE 18 leg (a); docs/ROBUSTNESS.md "Serving").
+
+The paper's evaluation grid is rule x attack x topology x strength x
+seed, but only a FRACTION of those axes is trace-relevant: strength is a
+traced ``attack_scale`` input and seed is an RNG lane, while rule, attack
+type and topology family change the traced program.  This scheduler makes
+that split explicit and machine-checked:
+
+- **Bucketing key = the jaxpr skeleton.**  Every (rule, attack, topology)
+  cell class traces one representative member program and takes
+  :func:`analysis.ir.jaxpr_signature` of it — the depth-annotated
+  primitive sequence MUR203/MUR500 already use for structural equality.
+  Cells share a bucket iff their skeletons are equal (MUR1600).  Classes
+  whose skeletons collide but whose configs are not value-compatible
+  (different trace-time closure constants — e.g. two rules that happen to
+  lower to the same primitive sequence with different baked parameters)
+  cannot share one *compiled* bucket, so the scheduler refuses them loud
+  instead of silently paying a hidden recompile.
+- **One compile per bucket.**  A bucket's strength x seed cells become
+  gang members (core/gang.py) padded to the power-of-two ``next_bucket``
+  lane count, trained on the fused multi-round path — ONE compile covers
+  every cell in the bucket, verified per bucket by
+  :class:`analysis.sanitizers.CompileTracker` and recorded in the
+  manifest.
+- **One cross-cell manifest.**  ``grid.json`` carries the bucket plan
+  (cells per bucket, compiles, wall), per-cell accuracy and phase-time
+  accounting — rendered by ``murmura report --grid``.
+
+The daemon (serve/daemon.py) reuses :func:`structural_fingerprint` as its
+admission key: submissions whose configs differ only in trace-irrelevant
+fields (experiment seed/name, training.lr — lifted to a traced ``hp_lr``
+input) land in one warm bucket.
+"""
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from murmura_tpu.config.schema import Config, GridConfig
+
+GRID_SCHEMA_VERSION = 1
+
+# Config sections that never reach the traced round program: identity,
+# observability, durability and driver blocks.  Everything else is
+# structural — it either changes the jaxpr skeleton or a trace-time
+# closure constant, and therefore the bucket.
+_NON_STRUCTURAL_SECTIONS = (
+    "telemetry", "durability", "sweep", "frontier", "grid", "serve",
+)
+# Trace-irrelevant leaves inside structural sections: the member axis.
+# ``training.lr`` is only value-varying when the gang lifts it to a
+# traced ``hp_lr`` input, which the serve path always does.
+_MEMBER_LEAVES = (("experiment", "name"), ("experiment", "seed"),
+                  ("experiment", "verbose"), ("training", "lr"))
+
+
+def structural_fingerprint(config: Config) -> str:
+    """Stable hash of the config's trace-relevant content — the daemon's
+    admission key.  Two configs with equal fingerprints build member
+    programs that are value-compatible with one warm compiled bucket:
+    same jaxpr skeleton AND same trace-time closure constants (attack
+    placement/std, topology seed, rule params, shapes).  The executable
+    MUR1600 contract verifies the skeleton half of this claim by
+    re-tracing probe cells independently."""
+    raw = config.model_dump()
+    for section in _NON_STRUCTURAL_SECTIONS:
+        raw.pop(section, None)
+    for section, leaf in _MEMBER_LEAVES:
+        if isinstance(raw.get(section), dict):
+            raw[section].pop(leaf, None)
+    blob = json.dumps(raw, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def program_skeleton(prog) -> Tuple[str, ...]:
+    """The round program's jaxpr skeleton: trace ``train_step`` over
+    canonical inputs (the analysis/composition.py recipe) and take the
+    MUR203 structural signature.  Trace-only — nothing compiles."""
+    from murmura_tpu.analysis.composition import _trace_program
+    from murmura_tpu.analysis.ir import jaxpr_signature
+
+    return jaxpr_signature(_trace_program(prog))
+
+
+@dataclass(frozen=True)
+class GridCell:
+    """One executable point of the grid."""
+
+    rule: str
+    attack: str
+    topology: str
+    strength: float
+    seed: int
+
+    @property
+    def cell_id(self) -> str:
+        return (
+            f"{self.rule}/{self.attack}/{self.topology}"
+            f"/g{self.strength:g}/s{self.seed}"
+        )
+
+    @property
+    def class_key(self) -> Tuple[str, str, str]:
+        """The cell's structural class: the axes that change the traced
+        program.  Strength and seed are traced inputs inside a class."""
+        return (self.rule, self.attack, self.topology)
+
+
+@dataclass
+class GridBucket:
+    """One compile-compatible bucket: every cell shares the skeleton (and
+    the class config's closure constants), so one gang = one compile."""
+
+    key: str
+    rule: str
+    attack: str
+    topology: str
+    skeleton: Tuple[str, ...] = field(repr=False, default=())
+    cells: List[GridCell] = field(default_factory=list)
+    config: Optional[Config] = field(repr=False, default=None)
+
+
+def expand_cells(config: Config, g: GridConfig) -> List[GridCell]:
+    """The configured grid as a flat cell list.  Benign (``none``) cells
+    carry strength 0 only — there is no perturbation to scale."""
+    seeds = (
+        [int(s) for s in g.seeds]
+        if g.seeds is not None
+        else [config.experiment.seed, config.experiment.seed + 1]
+    )
+    cells: List[GridCell] = []
+    for rule in g.rules:
+        for attack in g.attacks:
+            strengths = [0.0] if attack == "none" else list(g.strengths)
+            for topology in g.topologies:
+                for strength in strengths:
+                    for seed in seeds:
+                        cells.append(GridCell(
+                            rule=rule, attack=attack, topology=topology,
+                            strength=float(strength), seed=int(seed),
+                        ))
+    return cells
+
+
+def class_config(
+    config: Config, g: GridConfig, rule: str, attack: str, topology: str,
+    members: Optional[List[Dict[str, Any]]] = None,
+) -> Config:
+    """One structural class's runnable config, derived from the base
+    experiment (the frontier._cell_config discipline): rule params come
+    from the user's config for the configured rule, else the canonical
+    AGG_CASES inventory; the attack placement is pinned to the base
+    experiment seed so every member of every generation shares the
+    attack's static closures; telemetry/durability/driver blocks are
+    stripped — the grid manifest IS the output."""
+    from murmura_tpu.analysis.ir import AGG_CASES
+
+    raw = config.model_dump()
+    raw["aggregation"] = {
+        "algorithm": rule,
+        "params": (
+            dict(config.aggregation.params)
+            if rule == config.aggregation.algorithm
+            else dict(AGG_CASES.get(rule, {}))
+        ),
+    }
+    base_attack = config.attack
+    if attack == "none":
+        raw["attack"] = {"enabled": False}
+    else:
+        params: Dict[str, Any] = {}
+        if attack == "gaussian":
+            params["noise_std"] = float(
+                base_attack.params.get("noise_std", 10.0)
+            ) if base_attack.type == "gaussian" else 10.0
+        elif attack == "alie" and base_attack.type == "alie":
+            if "z" in base_attack.params:
+                params["z"] = base_attack.params["z"]
+        # Pin the compromised placement to the base experiment seed so
+        # every member shares the attack's static closures (the gang
+        # contract, core/gang.py).
+        params["seed"] = int(
+            base_attack.params.get("seed", config.experiment.seed)
+        )
+        raw["attack"] = {
+            "enabled": True,
+            "type": attack,
+            "percentage": (
+                base_attack.percentage if base_attack.enabled else 0.25
+            ),
+            "params": params,
+        }
+    n = config.topology.num_nodes
+    if topology == "sparse":
+        raw["topology"] = {"type": "exponential", "num_nodes": n}
+    elif config.topology.type in ("exponential", "one_peer"):
+        raw["topology"] = {
+            "type": "k-regular", "num_nodes": n, "k": min(4, n - 1),
+        }
+    else:
+        raw["topology"] = config.topology.model_dump()
+    if g.rounds is not None:
+        raw["experiment"] = {**raw["experiment"], "rounds": int(g.rounds)}
+    raw["experiment"]["verbose"] = False
+    for section in _NON_STRUCTURAL_SECTIONS:
+        raw.pop(section, None)
+    if members is not None:
+        raw["sweep"] = {"members": members}
+    try:
+        return Config.model_validate(raw)
+    except Exception as e:  # noqa: BLE001 — surface as the CLI's error kind
+        from murmura_tpu.utils.factories import ConfigError
+
+        raise ConfigError(
+            f"grid cell class {rule} x {attack} x {topology} does not "
+            f"validate against the base config: {e}"
+        ) from e
+
+
+def _cell_members(cells: Sequence[GridCell], attack: str) -> List[Dict[str, Any]]:
+    if attack == "none":
+        return [{"seed": c.seed} for c in cells]
+    return [
+        {"seed": c.seed, "attack_scale": c.strength} for c in cells
+    ]
+
+
+def cell_skeleton(config: Config, g: GridConfig, cell: GridCell) -> Tuple[str, ...]:
+    """One cell's INDEPENDENTLY-derived jaxpr skeleton: build that exact
+    cell's single-member program and trace it.  The MUR1600 verification
+    primitive — the planner's per-class representative trace must agree
+    with every member cell's own trace."""
+    from murmura_tpu.core.gang import resolve_members
+    from murmura_tpu.utils.factories import build_gang_member_programs
+
+    cfg = class_config(
+        config, g, cell.rule, cell.attack, cell.topology,
+        members=_cell_members([cell], cell.attack),
+    )
+    members = resolve_members(cfg)
+    return program_skeleton(build_gang_member_programs(cfg, members)[0])
+
+
+def plan_grid(config: Config, g: Optional[GridConfig] = None) -> List[GridBucket]:
+    """Partition the configured grid into compile-compatible buckets.
+
+    One representative member program is traced per structural class
+    (rule x attack x topology); classes with equal skeletons would merge
+    — but two classes with equal skeletons and DIFFERENT class configs
+    have different trace-time closure constants, so a merged bucket could
+    not actually share a compile, and the planner refuses loud (the
+    MUR1600 ⇔ contract stays honest: on every grid this scheduler runs,
+    same bucket ⇔ structurally equal skeletons).  Trace-only: nothing
+    compiles or executes here."""
+    from murmura_tpu.core.gang import resolve_members
+    from murmura_tpu.utils.factories import ConfigError, build_gang_member_programs
+
+    g = g or config.grid or GridConfig()
+    from murmura_tpu.aggregation import AGGREGATORS
+
+    unknown = sorted(set(g.rules) - set(AGGREGATORS))
+    if unknown:
+        raise ConfigError(
+            f"grid.rules names unregistered aggregation rule(s) "
+            f"{unknown}; known: {sorted(AGGREGATORS)}"
+        )
+    cells = expand_cells(config, g)
+    classes: Dict[Tuple[str, str, str], List[GridCell]] = {}
+    for cell in cells:
+        classes.setdefault(cell.class_key, []).append(cell)
+
+    by_skeleton: Dict[Tuple[str, ...], GridBucket] = {}
+    buckets: List[GridBucket] = []
+    for (rule, attack, topology), cls_cells in classes.items():
+        cfg = class_config(
+            config, g, rule, attack, topology,
+            members=_cell_members(cls_cells, attack),
+        )
+        probe_cfg = class_config(
+            config, g, rule, attack, topology,
+            members=_cell_members(cls_cells[:1], attack),
+        )
+        probe = build_gang_member_programs(
+            probe_cfg, resolve_members(probe_cfg)
+        )[0]
+        skeleton = program_skeleton(probe)
+        prior = by_skeleton.get(skeleton)
+        if prior is not None:
+            raise ConfigError(
+                f"grid classes {prior.rule} x {prior.attack} x "
+                f"{prior.topology} and {rule} x {attack} x {topology} "
+                "have structurally equal jaxpr skeletons but different "
+                "configs — their trace-time closure constants differ, so "
+                "one compiled bucket cannot serve both; differentiate "
+                "the grid axes (or run them as separate grids)"
+            )
+        key = hashlib.sha256(
+            "\n".join(skeleton).encode("utf-8")
+        ).hexdigest()[:12]
+        bucket = GridBucket(
+            key=key, rule=rule, attack=attack, topology=topology,
+            skeleton=skeleton, cells=list(cls_cells), config=cfg,
+        )
+        by_skeleton[skeleton] = bucket
+        buckets.append(bucket)
+    return buckets
+
+
+def run_grid(
+    config: Config,
+    progress: Optional[Callable[[str], None]] = None,
+) -> Dict[str, Any]:
+    """Plan + execute the full grid; returns the ``grid.json`` manifest.
+
+    Every bucket runs as one gang on the fused dispatch path
+    (``rounds_per_dispatch=rounds``): one compile per bucket, counted by
+    CompileTracker and recorded per bucket AND as the manifest total —
+    the ≤-compiles acceptance gate is checkable from the artifact
+    alone."""
+    import time
+
+    from murmura_tpu.analysis.sanitizers import track_compiles
+    from murmura_tpu.core.gang import resolve_members
+    from murmura_tpu.utils.factories import build_gang_from_config
+
+    say = progress or (lambda s: None)
+    g = config.grid or GridConfig()
+    buckets = plan_grid(config, g)
+    say(
+        f"grid: {sum(len(b.cells) for b in buckets)} cells in "
+        f"{len(buckets)} compile-compatible buckets"
+    )
+
+    bucket_rows: List[Dict[str, Any]] = []
+    cell_rows: List[Dict[str, Any]] = []
+    total_compiles = 0
+    for bucket in buckets:
+        cfg = bucket.config
+        rounds = cfg.experiment.rounds
+        say(
+            f"bucket {bucket.key} ({bucket.rule} x {bucket.attack} x "
+            f"{bucket.topology}): {len(bucket.cells)} cells"
+        )
+        gang = build_gang_from_config(cfg)
+        t0 = time.perf_counter()
+        with track_compiles() as tracker:
+            histories = gang.train(
+                rounds=rounds, eval_every=rounds,
+                rounds_per_dispatch=rounds,
+            )
+        wall = time.perf_counter() - t0
+        compiles = tracker.total
+        total_compiles += compiles
+        bucket_rows.append({
+            "key": bucket.key,
+            "rule": bucket.rule,
+            "attack": bucket.attack,
+            "topology": bucket.topology,
+            "cells": [c.cell_id for c in bucket.cells],
+            "batch": gang.batch,
+            "gang_size": gang.gang_size,
+            "rounds": rounds,
+            "compiles": compiles,
+            "wall_s": wall,
+            "skeleton_eqns": len(bucket.skeleton),
+        })
+        mean_round_s = (
+            float(np.mean(gang.round_times)) if gang.round_times else 0.0
+        )
+        for i, cell in enumerate(bucket.cells):
+            hist = histories[i]
+            honest = hist.get("honest_accuracy") or hist.get("mean_accuracy")
+            mean = hist.get("mean_accuracy")
+            cell_rows.append({
+                "id": cell.cell_id,
+                "rule": cell.rule,
+                "attack": cell.attack,
+                "topology": cell.topology,
+                "strength": cell.strength,
+                "seed": cell.seed,
+                "bucket": bucket.key,
+                "final_accuracy": float(mean[-1]) if mean else None,
+                "honest_accuracy": float(honest[-1]) if honest else None,
+                "phase_times": {
+                    "mode": "gang_fused",
+                    "rounds": rounds,
+                    "bucket_wall_s": wall,
+                    "mean_round_s": mean_round_s,
+                },
+            })
+
+    seeds = (
+        [int(s) for s in g.seeds]
+        if g.seeds is not None
+        else [config.experiment.seed, config.experiment.seed + 1]
+    )
+    return {
+        "schema_version": GRID_SCHEMA_VERSION,
+        "generated_by": "murmura grid",
+        "experiment": config.experiment.name,
+        "grid": {
+            "rules": list(g.rules),
+            "attacks": list(g.attacks),
+            "topologies": list(g.topologies),
+            "strengths": list(g.strengths),
+            "seeds": seeds,
+            "rounds": g.rounds or config.experiment.rounds,
+            "num_nodes": config.topology.num_nodes,
+        },
+        "buckets": bucket_rows,
+        "cells": cell_rows,
+        "total_cells": len(cell_rows),
+        "total_compiles": total_compiles,
+    }
+
+
+def write_grid(artifact: Dict[str, Any], path) -> Path:
+    """Durably write the manifest (the frontier/checkpoint fsync
+    discipline — a grid run is minutes of compute the write must not
+    tear)."""
+    from murmura_tpu.utils.checkpoint import durable_replace
+
+    path = Path(path).resolve()
+    path.parent.mkdir(parents=True, exist_ok=True)
+    durable_replace(
+        path.parent, path.name,
+        (json.dumps(artifact, indent=2) + "\n").encode("utf-8"),
+    )
+    return path
+
+
+def load_grid(path) -> Dict[str, Any]:
+    with open(path, encoding="utf-8") as fh:
+        artifact = json.load(fh)
+    if "buckets" not in artifact or "cells" not in artifact:
+        raise ValueError(
+            f"{path} is not a grid manifest (no 'buckets'/'cells' section)"
+        )
+    return artifact

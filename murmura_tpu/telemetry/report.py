@@ -531,3 +531,69 @@ def render_frontier(artifact: Dict[str, Any], console=None) -> None:
                 _fmt(row.get("std", float("nan")), 3), _bar(frac), summary,
             )
         console.print(ct)
+
+
+def render_grid(artifact: Dict[str, Any], console=None) -> None:
+    """Render a ``grid.json`` manifest (murmura_tpu/serve/scheduler.py):
+    one bucket table (cells per compile-compatible bucket, its ONE
+    compile, wall time), then the per-cell accuracy grid.
+
+    The number to read first is ``total_compiles`` vs ``total_cells``:
+    the scheduler's whole job is making the first much smaller than the
+    second (the README 50-cell grid runs in 5 compiles).  A bucket whose
+    ``compiles`` exceeds 1 means a cell smuggled a structural difference
+    past the skeleton key — exactly what `murmura check --serve`
+    (MUR1600/1601) exists to refuse.
+    """
+    from rich.console import Console
+    from rich.table import Table
+
+    console = console or Console()
+    grid = artifact.get("grid") or {}
+    console.print(
+        f"[bold cyan]murmura grid[/bold cyan] — "
+        f"[bold]{artifact.get('experiment', '?')}[/bold] "
+        f"(nodes={grid.get('num_nodes', '?')}, "
+        f"rounds={grid.get('rounds', '?')}, seeds={grid.get('seeds', '?')}): "
+        f"[bold]{artifact.get('total_cells', '?')}[/bold] cells in "
+        f"[bold]{len(artifact.get('buckets', []))}[/bold] buckets, "
+        f"[bold]{artifact.get('total_compiles', '?')}[/bold] compiles"
+    )
+    bt = Table(title="Compile-compatible buckets (one gang = one compile)")
+    bt.add_column("bucket", style="cyan")
+    bt.add_column("rule")
+    bt.add_column("attack")
+    bt.add_column("topology")
+    bt.add_column("cells", justify="right")
+    bt.add_column("lanes", justify="right")
+    bt.add_column("compiles", justify="right")
+    bt.add_column("wall s", justify="right")
+    for b in artifact.get("buckets", []):
+        compiles = b.get("compiles")
+        bt.add_row(
+            str(b.get("key")), str(b.get("rule")), str(b.get("attack")),
+            str(b.get("topology")), str(len(b.get("cells", []))),
+            f"{b.get('gang_size', '?')}/{b.get('batch', '?')}",
+            f"[bold red]{compiles}[/bold red]"
+            if (compiles or 0) > 1 else str(compiles),
+            _fmt(b.get("wall_s", float("nan")), 2),
+        )
+    console.print(bt)
+    ct = Table(title="Cells (accuracy by rule x attack x strength x seed)")
+    ct.add_column("cell", style="cyan")
+    ct.add_column("bucket")
+    ct.add_column("strength", justify="right")
+    ct.add_column("seed", justify="right")
+    ct.add_column("final acc", justify="right")
+    ct.add_column("honest acc", justify="right")
+    ct.add_column("mean round s", justify="right")
+    for c in artifact.get("cells", []):
+        phase = c.get("phase_times") or {}
+        ct.add_row(
+            str(c.get("id")), str(c.get("bucket")),
+            f"{c.get('strength', float('nan')):g}", str(c.get("seed")),
+            _fmt(c.get("final_accuracy"), 3),
+            _fmt(c.get("honest_accuracy"), 3),
+            _fmt(phase.get("mean_round_s", float("nan")), 3),
+        )
+    console.print(ct)

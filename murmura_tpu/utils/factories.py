@@ -530,108 +530,21 @@ def _node_axis_sharded(config: Config, mesh=None) -> bool:
     return nd > 1
 
 
-def build_gang_from_config(config: Config, seeds=None, mesh=None,
-                           checkpoint_dir=None, retain_init=False):
-    """Gang wiring (core/gang.py): one traced round program, S stacked
-    member experiments — the ``murmura sweep`` / ``murmura run --seeds``
-    path.
-
-    Mirrors :func:`build_network_from_config` except that data, initial
-    params, RNG bases and (optionally) traced scalar hyperparameters are
-    built per member and stacked along a leading [S] axis, while the
-    attack placement, topology, mobility and fault schedule stay shared
-    (their seeds are independent of the experiment seed by construction —
-    ``attack.params.seed`` defaults to the BASE config's experiment seed
-    here so member programs share the attack's static closures).
-
-    ``seeds``: explicit member-seed override (the CLI ``--seeds`` flag);
-    otherwise ``config.sweep`` defines the members.
-    """
-    import os
-
-    from murmura_tpu.core.gang import (
-        GangNetwork,
-        gang_hp_inputs,
-        next_bucket,
-        resolve_members,
-    )
+def _gang_member_programs(config: Config, members, *, topology, attack,
+                          sparse, node_axis_sharded, gang_param_shards):
+    """Per-member RoundPrograms for a gang: data, init params and RNG are
+    built per member seed while the attack placement / topology closures
+    stay shared (the gang parity contract, core/gang.py).  Extracted from
+    :func:`build_gang_from_config` so `murmura serve` can build a fresh
+    generation's programs for value-only admission into a warm bucket
+    (``GangNetwork.reset_run(member_programs=...)``) without constructing
+    — and re-jitting — a new GangNetwork."""
+    from murmura_tpu.core.gang import gang_hp_inputs
     from murmura_tpu.core.rounds import build_round_program as _build_program
 
-    if config.backend == "distributed":
-        raise ConfigError(
-            "gang-batched sweeps need the jitted backends; backend: "
-            "distributed trains in per-node OS processes (run seeds as "
-            "separate invocations there)"
-        )
-    if config.backend == "tpu" and config.tpu.multihost and mesh is None:
-        from murmura_tpu.parallel.mesh import init_multihost
-
-        init_multihost(
-            coordinator_address=config.tpu.coordinator_address,
-            num_processes=config.tpu.num_processes,
-            process_id=config.tpu.process_id,
-        )
-    apply_compilation_cache(config)
-
-    try:
-        members = resolve_members(config, seeds)
-    except ValueError as e:
-        raise ConfigError(str(e))
     hp_inputs = gang_hp_inputs(members)
-    bucket = config.sweep.bucket if config.sweep is not None else True
-    batch = next_bucket(len(members)) if bucket else len(members)
-
     n = config.topology.num_nodes
     rounds = config.experiment.rounds
-    topology = create_topology(
-        config.topology.type,
-        num_nodes=n,
-        p=config.topology.p,
-        k=config.topology.k,
-        seed=config.topology.seed,
-    )
-    from murmura_tpu.topology.sparse import SparseTopology
-
-    sparse = isinstance(topology, SparseTopology)
-    if sparse and config.backend == "tpu":
-        # The [k, N] edge mask rides the gang's vmap unbatched exactly
-        # like the dense [N, N] matrix (lifted for ISSUE 11 — the
-        # frontier sweeps sparse exponential graphs), but the gang MESH
-        # still shards adjacency on node rows: the sparse mask needs the
-        # edge_mask_sharding layout, which the gang path has not wired.
-        raise ConfigError(refusal_reason("sparse", "sweep", "tpu_backend"))
-    if config.population is not None and config.population.enabled:
-        # The CLI `--seeds N` path reaches here with sweep=None, so the
-        # schema's population x sweep validator never saw this pair.
-        raise ConfigError(refusal_reason("population", "sweep"))
-    # ONE attack for the whole gang: its compromised placement is seeded by
-    # attack.params.seed (default: the base experiment seed), never by the
-    # member seed — member programs share the attack's static closures
-    # (e.g. the gaussian scatter matrix).  A single run reproduces a gang
-    # member exactly by pinning attack.params.seed to this gang's base.
-    attack = build_attack(config)
-    mobility = build_mobility(config)
-
-    gang_param_shards = (
-        config.tpu.param_shards if config.backend == "tpu" else 1
-    )
-    if config.backend == "tpu" and mesh is None:
-        if gang_param_shards > 1:
-            # The sharding x sweep lift (ISSUE 16): a 4-D-role
-            # ("seed", "nodes", "param") mesh so the gang's [S, N, P]
-            # stacked state shards its trailing flat axis too.
-            from murmura_tpu.parallel.mesh import make_gang_param_mesh
-
-            mesh = make_gang_param_mesh(
-                batch, n, gang_param_shards, config.tpu.num_devices
-            )
-        else:
-            from murmura_tpu.parallel.mesh import make_gang_mesh
-
-            mesh = make_gang_mesh(batch, n, config.tpu.num_devices)
-    node_axis_sharded = (
-        mesh is not None and dict(mesh.shape).get("nodes", 1) > 1
-    )
 
     dmtt = None
     if config.dmtt is not None:
@@ -670,7 +583,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
                 agg_params["exchange_offsets"] = list(topology.offsets)
                 agg_params["sparse_exchange"] = True
             elif config.backend == "tpu" and config.tpu.exchange == "ppermute":
-                if mobility is not None or config.dmtt is not None:
+                if config.mobility is not None or config.dmtt is not None:
                     raise ConfigError(
                         "tpu.exchange: ppermute requires a static circulant "
                         "topology (mobility/dmtt graphs change per round)"
@@ -687,7 +600,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
                 config.aggregation.algorithm
                 in ("krum", "median", "trimmed_mean", "geometric_median")
                 and not sparse
-                and mobility is None
+                and config.mobility is None
                 and config.dmtt is None
             ):
                 agg_params.setdefault(
@@ -751,6 +664,158 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             pipeline=config.exchange.pipeline,
             param_shards=gang_param_shards,
         ))
+    return member_programs
+
+
+def build_gang_member_programs(config: Config, members):
+    """Public per-member program builder for the serve admission path
+    (serve/daemon.py): build ONE generation's RoundPrograms — per-seed
+    data shards, init params, per-member lr — exactly as
+    :func:`build_gang_from_config` would, without constructing a gang.
+    The returned programs are VALUE sources for an existing warm bucket
+    (``GangNetwork.reset_run(member_programs=...)``); they are never
+    traced, so they must come from a config whose structural fingerprint
+    matches the bucket template's (serve/scheduler.py enforces this)."""
+    if config.backend == "distributed":
+        raise ConfigError(
+            "gang-batched serving needs the jitted backends; backend: "
+            "distributed trains in per-node OS processes"
+        )
+    n = config.topology.num_nodes
+    topology = create_topology(
+        config.topology.type,
+        num_nodes=n,
+        p=config.topology.p,
+        k=config.topology.k,
+        seed=config.topology.seed,
+    )
+    from murmura_tpu.topology.sparse import SparseTopology
+
+    sparse = isinstance(topology, SparseTopology)
+    attack = build_attack(config)
+    return _gang_member_programs(
+        config, members,
+        topology=topology,
+        attack=attack,
+        sparse=sparse,
+        node_axis_sharded=_node_axis_sharded(config, None),
+        gang_param_shards=(
+            config.tpu.param_shards if config.backend == "tpu" else 1
+        ),
+    )
+
+
+def build_gang_from_config(config: Config, seeds=None, mesh=None,
+                           checkpoint_dir=None, retain_init=False,
+                           min_batch=1):
+    """Gang wiring (core/gang.py): one traced round program, S stacked
+    member experiments — the ``murmura sweep`` / ``murmura run --seeds``
+    path.
+
+    Mirrors :func:`build_network_from_config` except that data, initial
+    params, RNG bases and (optionally) traced scalar hyperparameters are
+    built per member and stacked along a leading [S] axis, while the
+    attack placement, topology, mobility and fault schedule stay shared
+    (their seeds are independent of the experiment seed by construction —
+    ``attack.params.seed`` defaults to the BASE config's experiment seed
+    here so member programs share the attack's static closures).
+
+    ``seeds``: explicit member-seed override (the CLI ``--seeds`` flag);
+    otherwise ``config.sweep`` defines the members.
+    """
+    import os
+
+    from murmura_tpu.core.gang import (
+        GangNetwork,
+        next_bucket,
+        resolve_members,
+    )
+
+    if config.backend == "distributed":
+        raise ConfigError(
+            "gang-batched sweeps need the jitted backends; backend: "
+            "distributed trains in per-node OS processes (run seeds as "
+            "separate invocations there)"
+        )
+    if config.backend == "tpu" and config.tpu.multihost and mesh is None:
+        from murmura_tpu.parallel.mesh import init_multihost
+
+        init_multihost(
+            coordinator_address=config.tpu.coordinator_address,
+            num_processes=config.tpu.num_processes,
+            process_id=config.tpu.process_id,
+        )
+    apply_compilation_cache(config)
+
+    try:
+        members = resolve_members(config, seeds)
+    except ValueError as e:
+        raise ConfigError(str(e))
+    bucket = config.sweep.bucket if config.sweep is not None else True
+    batch = (
+        next_bucket(max(len(members), min_batch))
+        if bucket else len(members)
+    )
+
+    n = config.topology.num_nodes
+    topology = create_topology(
+        config.topology.type,
+        num_nodes=n,
+        p=config.topology.p,
+        k=config.topology.k,
+        seed=config.topology.seed,
+    )
+    from murmura_tpu.topology.sparse import SparseTopology
+
+    sparse = isinstance(topology, SparseTopology)
+    if sparse and config.backend == "tpu":
+        # The [k, N] edge mask rides the gang's vmap unbatched exactly
+        # like the dense [N, N] matrix (lifted for ISSUE 11 — the
+        # frontier sweeps sparse exponential graphs), but the gang MESH
+        # still shards adjacency on node rows: the sparse mask needs the
+        # edge_mask_sharding layout, which the gang path has not wired.
+        raise ConfigError(refusal_reason("sparse", "sweep", "tpu_backend"))
+    if config.population is not None and config.population.enabled:
+        # The CLI `--seeds N` path reaches here with sweep=None, so the
+        # schema's population x sweep validator never saw this pair.
+        raise ConfigError(refusal_reason("population", "sweep"))
+    # ONE attack for the whole gang: its compromised placement is seeded by
+    # attack.params.seed (default: the base experiment seed), never by the
+    # member seed — member programs share the attack's static closures
+    # (e.g. the gaussian scatter matrix).  A single run reproduces a gang
+    # member exactly by pinning attack.params.seed to this gang's base.
+    attack = build_attack(config)
+    mobility = build_mobility(config)
+
+    gang_param_shards = (
+        config.tpu.param_shards if config.backend == "tpu" else 1
+    )
+    if config.backend == "tpu" and mesh is None:
+        if gang_param_shards > 1:
+            # The sharding x sweep lift (ISSUE 16): a 4-D-role
+            # ("seed", "nodes", "param") mesh so the gang's [S, N, P]
+            # stacked state shards its trailing flat axis too.
+            from murmura_tpu.parallel.mesh import make_gang_param_mesh
+
+            mesh = make_gang_param_mesh(
+                batch, n, gang_param_shards, config.tpu.num_devices
+            )
+        else:
+            from murmura_tpu.parallel.mesh import make_gang_mesh
+
+            mesh = make_gang_mesh(batch, n, config.tpu.num_devices)
+    node_axis_sharded = (
+        mesh is not None and dict(mesh.shape).get("nodes", 1) > 1
+    )
+
+    member_programs = _gang_member_programs(
+        config, members,
+        topology=topology,
+        attack=attack,
+        sparse=sparse,
+        node_axis_sharded=node_axis_sharded,
+        gang_param_shards=gang_param_shards,
+    )
 
     writers = None
     if config.telemetry.enabled:
@@ -793,6 +858,7 @@ def build_gang_from_config(config: Config, seeds=None, mesh=None,
             transfer_guard=config.tpu.transfer_guard,
             telemetry_writers=writers,
             retain_init=retain_init,
+            min_batch=min_batch,
         )
     except ValueError as e:
         # Gang-batchability failures (ragged member shapes, unfactorable

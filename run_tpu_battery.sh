@@ -21,6 +21,7 @@ PIPELINE=0
 SHARDED=0
 COMPOSE=0
 MEMORY=0
+SERVE=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -35,6 +36,7 @@ while :; do
     --sharded) SHARDED=1; shift;;
     --compose) COMPOSE=1; shift;;
     --memory) MEMORY=1; shift;;
+    --serve) SERVE=1; shift;;
     *) break;;
   esac
 done
@@ -650,6 +652,202 @@ PYEOF
     exit 1
   fi
   echo "preflight memory clean" | tee -a "$OUT/battery.log"
+fi
+# Optional serving pre-flight (./run_tpu_battery.sh --serve [outdir]):
+# the ISSUE-18 gates, CPU-pinned — (a) the committed serve_grid.yaml grid
+# through the compile-compatible scheduler under tpu.recompile_guard must
+# cover >= 40 cells in <= 5 compiles, asserted from the grid.json
+# manifest's CompileTracker counts (docs/ROBUSTNESS.md "Serving"), and
+# (b) a daemon mini-soak with a REAL process death: a subprocess daemon
+# takes concurrent socket submissions, is SIGKILLed mid-generation (no
+# atexit, no finalization), a second subprocess rebinds over the stale
+# socket file (the EADDRINUSE transient path), recovers from the durable
+# ledger + cadence snapshots, and every submission must finish with a
+# history byte-identical to an uninterrupted in-process reference daemon
+# (MUR1603 end-to-end, with the kill landing wherever the scheduler
+# happened to be).
+if [ "$SERVE" = 1 ]; then
+  echo "=== preflight: serving (grid <=5 compiles + daemon kill-mid-soak) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  SERVE_DIR="$OUT/serve_preflight"
+  rm -rf "$SERVE_DIR"
+  if ! timeout 2400 env JAX_PLATFORMS=cpu MURMURA_SERVE_DIR="$SERVE_DIR" python - > "$OUT/preflight_serve.out" 2>&1 <<'PYEOF'
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import yaml
+
+from murmura_tpu.analysis.durability import history_equal
+from murmura_tpu.config import Config
+from murmura_tpu.serve.daemon import TERMINAL_STATES, ServeDaemon
+from murmura_tpu.serve.protocol import send_request
+from murmura_tpu.serve.scheduler import run_grid, write_grid
+
+serve_dir = Path(os.environ["MURMURA_SERVE_DIR"])
+serve_dir.mkdir(parents=True, exist_ok=True)
+
+# -- (a) the committed grid: >= 40 cells in <= 5 compiles ----------------
+raw = yaml.safe_load(Path("examples/configs/serve_grid.yaml").read_text())
+# recompile_guard arms CompileTracker inside each bucket's gang: a
+# compile after a bucket's fused warmup raises instead of silently
+# re-lowering — the manifest's per-bucket counts stay honest.
+raw["tpu"] = dict(raw.get("tpu") or {}, recompile_guard=True)
+art = run_grid(Config.model_validate(raw), progress=print)
+write_grid(art, serve_dir / "grid.json")
+print(f"grid: {art['total_cells']} cells, {art['total_compiles']} compiles")
+if art["total_cells"] < 40 or art["total_compiles"] > 5:
+    print(f"FAIL: grid gate is >= 40 cells in <= 5 compiles, got "
+          f"{art['total_cells']} cells / {art['total_compiles']} compiles")
+    sys.exit(1)
+
+# -- (b) daemon mini-soak: SIGKILL mid-generation, byte-identical finish -
+ROUNDS = 4
+SEEDS = (5, 6, 7)
+
+
+def tenant(seed):
+    return {
+        "experiment": {"name": f"soak-{seed}", "seed": seed,
+                       "rounds": ROUNDS},
+        "topology": {"type": "ring", "num_nodes": 5},
+        "aggregation": {"algorithm": "krum",
+                        "params": {"num_compromised": 1}},
+        "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 40, "input_shape": [6],
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 6, "hidden_dims": [8],
+                             "num_classes": 3}},
+        "backend": "simulation",
+        "tpu": {"recompile_guard": True, "num_devices": 1,
+                "compute_dtype": "float32"},
+    }
+
+
+def daemon_raw(state_dir):
+    r = tenant(0)
+    r["serve"] = {"state_dir": str(state_dir), "capacity": 2,
+                  "checkpoint_every": 1, "poll_interval_s": 0.05}
+    return r
+
+
+# Uninterrupted in-process reference: the byte-identity baseline.
+ref = ServeDaemon(Config.model_validate(daemon_raw(serve_dir / "ref")))
+for seed in SEEDS:
+    ref.submit_config(tenant(seed))
+ref.drain()
+ref_hist = {}
+for rec in ref._ledger.values():
+    if rec["state"] != "done":
+        print(f"FAIL: reference daemon left {rec['id']} {rec['state']}")
+        sys.exit(1)
+    ref_hist[rec["config"]["experiment"]["seed"]] = rec["history"]
+
+victim_dir = serve_dir / "victim"
+cfg_path = serve_dir / "victim_daemon.json"
+cfg_path.write_text(json.dumps(daemon_raw(victim_dir)))
+daemon_main = r"""
+import json, sys
+from pathlib import Path
+from murmura_tpu.config import Config
+from murmura_tpu.serve.daemon import ServeDaemon
+ServeDaemon(
+    Config.model_validate(json.loads(Path(sys.argv[1]).read_text()))
+).serve_forever()
+"""
+env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def spawn():
+    return subprocess.Popen(
+        [sys.executable, "-c", daemon_main, str(cfg_path)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT,
+    )
+
+
+def status(sock, sub_id):
+    return send_request(sock, {"op": "status", "id": sub_id})["submission"]
+
+
+def await_daemon(sock, timeout_s=180):
+    # A cold subprocess pays the full jax import before binding; poll the
+    # ping op (send_request's own retry envelope covers the connect races
+    # once the file exists).
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        try:
+            if send_request(sock, {"op": "ping"}, retries=2)["ok"]:
+                return
+        except (ConnectionError, TimeoutError, OSError):
+            time.sleep(0.5)
+    print(f"FAIL: daemon never answered ping at {sock}")
+    sys.exit(1)
+
+
+proc = spawn()
+sock = str(victim_dir / "daemon.sock")
+await_daemon(sock)
+ids = [
+    send_request(sock, {"op": "submit", "config": tenant(seed)})["id"]
+    for seed in SEEDS
+]
+print(f"submitted {ids} to daemon pid {proc.pid}")
+deadline = time.monotonic() + 300
+while time.monotonic() < deadline:
+    if any(status(sock, i)["state"] == "running" for i in ids):
+        break
+    time.sleep(0.05)
+else:
+    print("FAIL: no submission reached 'running' before the kill window")
+    sys.exit(1)
+os.kill(proc.pid, signal.SIGKILL)
+proc.wait()
+if proc.returncode != -signal.SIGKILL:
+    print(f"FAIL: daemon did not die by SIGKILL (rc={proc.returncode})")
+    sys.exit(1)
+print("daemon SIGKILLed mid-generation; restarting over the same "
+      "state_dir (stale socket file still on disk)")
+
+proc2 = spawn()
+await_daemon(sock)
+deadline = time.monotonic() + 600
+states = {}
+while time.monotonic() < deadline:
+    states = {i: status(sock, i)["state"] for i in ids}
+    if all(s in TERMINAL_STATES for s in states.values()):
+        break
+    time.sleep(0.2)
+send_request(sock, {"op": "shutdown"})
+proc2.wait(timeout=60)
+if not all(s == "done" for s in states.values()):
+    print(f"FAIL: not every submission finished 'done' after recovery: "
+          f"{states}")
+    sys.exit(1)
+
+for sub_id in ids:
+    rec = json.loads(
+        (victim_dir / "submissions" / f"{sub_id}.json").read_text()
+    )
+    seed = rec["config"]["experiment"]["seed"]
+    if not history_equal(rec["history"], ref_hist[seed]):
+        print(f"FAIL: {sub_id} (seed {seed}) resumed history diverges "
+              "from the uninterrupted reference daemon's")
+        sys.exit(1)
+print(f"serve preflight ok: {art['total_cells']} cells / "
+      f"{art['total_compiles']} compiles; kill-mid-soak recovered "
+      f"{len(ids)} submissions byte-identical")
+PYEOF
+  then
+    echo "preflight serve FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_serve.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight serve clean" | tee -a "$OUT/battery.log"
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

@@ -1,0 +1,280 @@
+"""The serving daemon (murmura_tpu/serve/daemon.py): admission refusals,
+socket-layer error classification, zero-recompile admission into the warm
+bucket (MUR1601 representative), eviction semantics, SIGKILL-resume
+byte-identity (MUR1603 representative + negative), and the socket
+protocol round trip.
+
+Tier-1 keeps the representatives compact (5-node ring, 2 rounds,
+synthetic data); the full MUR1600-1603 family runs in the package gate
+(``murmura check --serve``), exercised here under ``-m slow``.
+"""
+
+import errno
+import os
+import socket as socket_mod
+import threading
+import time
+
+import pytest
+
+from murmura_tpu.analysis.durability import history_equal
+from murmura_tpu.config import Config
+from murmura_tpu.durability import dispatch as ddispatch
+from murmura_tpu.serve.daemon import (
+    TERMINAL_STATES,
+    ServeDaemon,
+    SubmissionError,
+    normalize_submission,
+)
+from murmura_tpu.serve.protocol import send_request
+
+
+def _tenant(seed, lr=0.05, rounds=2, rule="fedavg"):
+    return {
+        "experiment": {"name": f"tenant-{seed}", "seed": seed,
+                       "rounds": rounds},
+        "topology": {"type": "ring", "num_nodes": 5},
+        "aggregation": {"algorithm": rule},
+        "training": {"local_epochs": 1, "batch_size": 8, "lr": lr},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 40, "input_shape": [6],
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 6, "hidden_dims": [8],
+                             "num_classes": 3}},
+        "backend": "simulation",
+    }
+
+
+def _daemon(tmp_path, name, capacity=2, checkpoint_every=1):
+    raw = _tenant(0)
+    raw["serve"] = {
+        "state_dir": str(tmp_path / name),
+        "capacity": capacity,
+        "checkpoint_every": checkpoint_every,
+        "poll_interval_s": 0.05,
+    }
+    return ServeDaemon(Config.model_validate(raw))
+
+
+class TestAdmission:
+    def test_driver_sections_refused(self):
+        for section, payload in (
+            ("sweep", {"members": [{"seed": 1}]}),
+            ("frontier", {"rules": ["fedavg"]}),
+            ("grid", {"rules": ["fedavg"]}),
+            ("serve", {"state_dir": "/tmp/x"}),
+        ):
+            with pytest.raises(SubmissionError, match=section):
+                normalize_submission({**_tenant(1), section: payload})
+
+    def test_distributed_backend_refused(self):
+        with pytest.raises(SubmissionError, match="distributed"):
+            normalize_submission({**_tenant(1), "backend": "distributed"})
+
+    def test_invalid_config_refused_with_reason(self):
+        raw = _tenant(1)
+        raw["training"]["lr"] = "not-a-float"
+        with pytest.raises(SubmissionError, match="invalid"):
+            normalize_submission(raw)
+
+    def test_member_axis_shares_the_admission_key(self):
+        _, fp_a = normalize_submission(_tenant(1, lr=0.05))
+        _, fp_b = normalize_submission(_tenant(99, lr=0.001))
+        _, fp_c = normalize_submission(_tenant(1, rule="median"))
+        assert fp_a == fp_b  # seed/name/lr are member lanes
+        assert fp_a != fp_c  # the rule changes the traced program
+
+
+class TestSocketErrorClassification:
+    """Satellite 1: the daemon's socket layer rides the durability
+    envelope — its failure modes must classify transient."""
+
+    def test_transport_exception_types_transient(self):
+        for exc in (
+            ConnectionResetError("peer went away"),
+            BrokenPipeError("write to dead daemon"),
+            ConnectionRefusedError("daemon restarting"),
+            socket_mod.timeout("recv"),
+        ):
+            assert ddispatch.classify_error(exc) == "transient"
+
+    def test_eaddrinuse_errno_transient(self):
+        # A SIGKILL'd daemon leaves a stale socket file; the rebind's
+        # EADDRINUSE arrives as a bare OSError — errno carries the class.
+        exc = OSError(errno.EADDRINUSE, "Address already in use")
+        assert ddispatch.classify_error(exc) == "transient"
+
+    def test_eaddrinuse_marker_transient(self):
+        exc = RuntimeError("bind failed: Address already in use")
+        assert ddispatch.classify_error(exc) == "transient"
+
+    def test_unrelated_oserror_stays_fatal(self):
+        exc = OSError(errno.ENOENT, "no such state dir")
+        assert ddispatch.classify_error(exc) == "fatal"
+
+
+class TestEviction:
+    def test_evicted_queued_tenant_never_runs(self, tmp_path):
+        d = _daemon(tmp_path, "evict")
+        a = d.submit_config(_tenant(5))["id"]
+        b = d.submit_config(_tenant(6))["id"]
+        rec = d.evict(a, "user cancel")
+        assert rec["state"] == "evicted"
+        assert rec["error"] == "user cancel"
+        nxt = d._next_generation()
+        assert nxt is not None and nxt[1] == [b]
+
+    def test_evict_is_idempotent_and_loud_on_unknown(self, tmp_path):
+        d = _daemon(tmp_path, "evict2")
+        a = d.submit_config(_tenant(5))["id"]
+        d.evict(a)
+        assert d.evict(a)["state"] == "evicted"
+        with pytest.raises(KeyError, match="sub-99999"):
+            d.evict("sub-99999")
+
+
+class TestWarmBucket:
+    def test_admission_after_first_generation_compiles_nothing(
+        self, tmp_path,
+    ):
+        # MUR1601 representative: the bucket compiles once, with its
+        # first generation; every later admission is a value-only
+        # reset_run splice into the warm lanes.
+        from murmura_tpu.analysis.sanitizers import track_compiles
+
+        d = _daemon(tmp_path, "warm", capacity=2)
+        gen1 = [d.submit_config(_tenant(5))["id"],
+                d.submit_config(_tenant(6, lr=0.02))["id"]]
+        d.drain()
+        gen2 = [d.submit_config(_tenant(21))["id"],
+                d.submit_config(_tenant(22, lr=0.01))["id"]]
+        with track_compiles() as tracker:
+            d.drain()
+        assert tracker.total == 0
+        for sub_id in gen1 + gen2:
+            rec = d._ledger[sub_id]
+            assert rec["state"] == "done"
+            assert rec["final_accuracy"] is not None
+            assert rec["phase_times"]["rounds"] == 2
+        assert len(d._buckets) == 1
+        (bucket,) = d._buckets.values()
+        assert bucket["gen"] == 2
+
+
+class _Kill(BaseException):
+    """SIGKILL stand-in: not an Exception, so no handler between the
+    training loop and the test can swallow it — the ledger is left with
+    'running' states exactly as a real kill would leave it."""
+
+
+class TestCrashResume:
+    def test_sigkill_resume_byte_identical(self, tmp_path, monkeypatch):
+        # MUR1603 representative: kill after round 1 of 2 (one cadence
+        # snapshot on disk), restart over the same state_dir, recover.
+        import murmura_tpu.core.gang as gang_mod
+
+        ref = _daemon(tmp_path, "ref")
+        for seed in (5, 6):
+            ref.submit_config(_tenant(seed))
+        ref.drain()
+        ref_hist = {
+            rec["config"]["experiment"]["seed"]: rec["history"]
+            for rec in ref._ledger.values()
+        }
+
+        victim = _daemon(tmp_path, "victim")
+        for seed in (5, 6):
+            victim.submit_config(_tenant(seed))
+        orig_train = gang_mod.GangNetwork.train
+
+        def dying_train(self, rounds, **kwargs):
+            orig_train(self, rounds=1, **kwargs)
+            raise _Kill()
+
+        monkeypatch.setattr(gang_mod.GangNetwork, "train", dying_train)
+        with pytest.raises(_Kill):
+            victim.drain()
+        for rec in victim._ledger.values():
+            assert rec["state"] == "running"
+        monkeypatch.setattr(gang_mod.GangNetwork, "train", orig_train)
+
+        revived = _daemon(tmp_path, "victim")  # same state_dir
+        recovered = revived.recover()
+        assert sorted(recovered) == ["sub-00001", "sub-00002"]
+        for rec in revived._ledger.values():
+            assert rec["state"] == "done"
+            seed = rec["config"]["experiment"]["seed"]
+            assert history_equal(rec["history"], ref_hist[seed])
+
+    def test_recover_without_generation_record_fails_loud(self, tmp_path):
+        # MUR1603 negative: a kill can land between the 'running' ledger
+        # write and the generation.json write only if the generation
+        # record itself was lost (it is written first) — recovery must
+        # not invent work, it marks the tenant failed with the reason.
+        d = _daemon(tmp_path, "neg")
+        sub_id = d.submit_config(_tenant(5))["id"]
+        d._pending.clear()
+        d._update(sub_id, state="running", gen=41, lane=0)
+
+        revived = _daemon(tmp_path, "neg")
+        assert revived.recover() == []
+        rec = revived._ledger[sub_id]
+        assert rec["state"] == "failed"
+        assert "generation record lost" in rec["error"]
+
+
+class TestSocketProtocol:
+    def test_submit_status_list_shutdown_round_trip(self, tmp_path):
+        d = _daemon(tmp_path, "sock")
+        thread = threading.Thread(target=d.serve_forever, daemon=True)
+        thread.start()
+        sp = d.socket_path
+        try:
+            ping = send_request(sp, {"op": "ping"})
+            assert ping["ok"] and ping["pid"] == os.getpid()
+
+            reply = send_request(sp, {"op": "submit", "config": _tenant(5)})
+            assert reply["ok"]
+            sub_id = reply["id"]
+
+            deadline = time.monotonic() + 120
+            state = None
+            while time.monotonic() < deadline:
+                status = send_request(sp, {"op": "status", "id": sub_id})
+                state = status["submission"]["state"]
+                if state in TERMINAL_STATES:
+                    break
+                time.sleep(0.1)
+            assert state == "done"
+            assert status["submission"]["final_accuracy"] is not None
+
+            bad = send_request(sp, {
+                "op": "submit",
+                "config": {**_tenant(7),
+                           "sweep": {"members": [{"seed": 1}]}},
+            })
+            assert not bad["ok"] and "sweep" in bad["error"]
+
+            rows = send_request(sp, {"op": "list"})["submissions"]
+            assert [r["id"] for r in rows] == [sub_id]
+
+            unknown = send_request(sp, {"op": "status", "id": "sub-nope"})
+            assert not unknown["ok"]
+        finally:
+            try:
+                send_request(sp, {"op": "shutdown"}, retries=1)
+            except Exception:
+                pass
+            thread.join(timeout=15)
+        assert not thread.is_alive()
+        assert not os.path.exists(sp)
+
+
+@pytest.mark.slow
+def test_check_serve_family_clean():
+    """The full MUR1600-1603 package gate comes back clean."""
+    from murmura_tpu.analysis.serve import check_serve
+
+    findings = check_serve(force=True)
+    assert findings == [], [f"{f.rule}: {f.message}" for f in findings]

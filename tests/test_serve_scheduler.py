@@ -1,0 +1,167 @@
+"""The grid scheduler (murmura_tpu/serve/scheduler.py): bucketing-key
+soundness, the planner's collision refusal, one-compile-per-bucket
+execution, and the manifest roundtrip.
+
+Socket-free tier-1 coverage for ISSUE 18 leg (a); the daemon half lives
+in tests/test_serve_daemon.py and the full MUR1600-1603 sweep in the
+package ``murmura check``.
+"""
+
+import json
+
+import pytest
+
+from murmura_tpu.config import Config
+from murmura_tpu.config.schema import GridConfig
+from murmura_tpu.serve import scheduler as sched
+from murmura_tpu.utils.factories import ConfigError
+
+
+def _base(grid=None, rounds=2, seed=7):
+    raw = {
+        "experiment": {"name": "serve-sched-test", "seed": seed,
+                       "rounds": rounds},
+        "topology": {"type": "ring", "num_nodes": 5},
+        "aggregation": {"algorithm": "fedavg"},
+        "training": {"local_epochs": 1, "batch_size": 8, "lr": 0.05},
+        "data": {"adapter": "synthetic",
+                 "params": {"num_samples": 40, "input_shape": [6],
+                            "num_classes": 3}},
+        "model": {"factory": "mlp",
+                  "params": {"input_dim": 6, "hidden_dims": [8],
+                             "num_classes": 3}},
+        "backend": "simulation",
+    }
+    if grid is not None:
+        raw["grid"] = grid
+    return Config.model_validate(raw)
+
+
+class TestCellExpansion:
+    def test_full_product_with_benign_strength_collapse(self):
+        g = GridConfig(rules=["fedavg", "median"],
+                       attacks=["gaussian", "none"],
+                       topologies=["dense"],
+                       strengths=[0.0, 1.0], seeds=[1, 2])
+        cells = sched.expand_cells(_base(), g)
+        # gaussian: 2 rules x 2 strengths x 2 seeds = 8;
+        # none has no strength axis: 2 rules x 1 x 2 seeds = 4.
+        assert len(cells) == 12
+        benign = [c for c in cells if c.attack == "none"]
+        assert len(benign) == 4
+        assert all(c.strength == 0.0 for c in benign)
+        assert len({c.cell_id for c in cells}) == 12
+
+    def test_default_seeds_derive_from_experiment(self):
+        g = GridConfig(rules=["fedavg"], attacks=["gaussian"],
+                       strengths=[1.0])
+        cells = sched.expand_cells(_base(seed=3), g)
+        assert sorted({c.seed for c in cells}) == [3, 4]
+
+
+class TestStructuralFingerprint:
+    def test_member_axis_is_trace_irrelevant(self):
+        a = _base()
+        b = _base(seed=99)
+        braw = b.model_dump()
+        braw["experiment"]["name"] = "other-name"
+        braw["training"]["lr"] = 0.001
+        b = Config.model_validate(braw)
+        assert (sched.structural_fingerprint(a)
+                == sched.structural_fingerprint(b))
+
+    def test_structural_axes_change_the_fingerprint(self):
+        a = _base()
+        braw = _base().model_dump()
+        braw["aggregation"] = {"algorithm": "median", "params": {}}
+        b = Config.model_validate(braw)
+        assert (sched.structural_fingerprint(a)
+                != sched.structural_fingerprint(b))
+
+    def test_driver_sections_never_reach_the_fingerprint(self):
+        a = _base()
+        b = _base(grid={"rules": ["fedavg", "median"]})
+        assert (sched.structural_fingerprint(a)
+                == sched.structural_fingerprint(b))
+
+
+class TestPlanGrid:
+    def test_equal_cells_collapse_unequal_cells_split(self):
+        config = _base(grid={
+            "rules": ["fedavg", "median"], "attacks": ["gaussian"],
+            "topologies": ["dense"], "strengths": [0.0, 1.0], "seeds": [7],
+        })
+        buckets = sched.plan_grid(config)
+        # One bucket per structural class: strength/seed collapse into
+        # member lanes, rules split.
+        assert len(buckets) == 2
+        assert {b.rule for b in buckets} == {"fedavg", "median"}
+        assert all(len(b.cells) == 2 for b in buckets)
+        skels = [b.skeleton for b in buckets]
+        assert skels[0] != skels[1]
+        assert len({b.key for b in buckets}) == 2
+
+    def test_unknown_rule_refused(self):
+        config = _base(grid={"rules": ["fedavg", "no_such_rule"]})
+        with pytest.raises(ConfigError, match="no_such_rule"):
+            sched.plan_grid(config)
+
+    def test_skeleton_collision_refused_loud(self, monkeypatch):
+        # Doctored skeletons: every class traces to the same signature.
+        # A merged bucket could not share a compile (different closure
+        # constants), so the planner must refuse — the MUR1600 ⇔ stays
+        # honest on every grid that actually runs.
+        monkeypatch.setattr(
+            sched, "program_skeleton", lambda prog: ("doctored",),
+        )
+        config = _base(grid={
+            "rules": ["fedavg", "median"], "attacks": ["gaussian"],
+            "strengths": [1.0], "seeds": [7],
+        })
+        with pytest.raises(ConfigError, match="structurally equal"):
+            sched.plan_grid(config)
+
+    def test_cell_skeleton_agrees_with_bucket(self):
+        # The MUR1600 verification primitive: a member cell's OWN trace
+        # equals the planner's per-class representative trace.
+        config = _base(grid={
+            "rules": ["median"], "attacks": ["gaussian"],
+            "strengths": [0.0, 2.0], "seeds": [7],
+        })
+        g = config.grid
+        (bucket,) = sched.plan_grid(config, g)
+        cell = bucket.cells[-1]
+        assert sched.cell_skeleton(config, g, cell) == bucket.skeleton
+
+
+class TestRunGrid:
+    def test_one_compile_per_bucket_and_manifest_shape(self):
+        config = _base(grid={
+            "rules": ["fedavg"], "attacks": ["gaussian"],
+            "topologies": ["dense"], "strengths": [0.0, 1.0], "seeds": [7],
+        })
+        art = sched.run_grid(config)
+        assert art["total_cells"] == 2
+        assert art["total_compiles"] == 1
+        (bucket,) = art["buckets"]
+        assert bucket["compiles"] == 1
+        assert bucket["gang_size"] == 2
+        assert len(art["cells"]) == 2
+        for cell in art["cells"]:
+            assert cell["bucket"] == bucket["key"]
+            assert cell["final_accuracy"] is not None
+            assert cell["phase_times"]["mode"] == "gang_fused"
+            assert cell["phase_times"]["rounds"] == 2
+
+    def test_manifest_roundtrip_and_junk_refused(self, tmp_path):
+        art = {
+            "schema_version": sched.GRID_SCHEMA_VERSION,
+            "experiment": "x", "grid": {}, "buckets": [], "cells": [],
+            "total_cells": 0, "total_compiles": 0,
+        }
+        path = sched.write_grid(art, tmp_path / "grid.json")
+        assert sched.load_grid(path) == art
+        junk = tmp_path / "junk.json"
+        junk.write_text(json.dumps({"hello": 1}))
+        with pytest.raises(ValueError, match="not a grid manifest"):
+            sched.load_grid(junk)

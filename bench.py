@@ -682,6 +682,9 @@ def main():
 
             from murmura_tpu.telemetry.writer import write_bench_manifest
 
+            # write_bench_manifest also drops a metrics.prom OpenMetrics
+            # snapshot next to the manifest (ISSUE 19) — the same
+            # serializer the serve daemon's metrics op renders.
             write_bench_manifest(
                 Path(__file__).parent / "telemetry_runs" / "bench",
                 "bench", payload,

@@ -594,7 +594,25 @@ def main():
                             "err": f"timeout after {args.timeout}s"})
         flush(done=False)
 
-    print(json.dumps(flush(done=True)))
+    blob = flush(done=True)
+    try:
+        # Final OpenMetrics snapshot next to the blob (ISSUE 19): the
+        # scalar leaves through the same serializer the daemon's metrics
+        # op renders, so BENCH trajectories scrape with stock tooling.
+        from murmura_tpu.telemetry.metrics import (
+            MetricsRegistry,
+            fold_bench_payload,
+            render_openmetrics,
+        )
+
+        reg = MetricsRegistry()
+        fold_bench_payload(reg, "bench_scaling", blob)
+        prom = Path(args.out).with_suffix(".prom")
+        prom.write_text(render_openmetrics(reg))
+    except Exception as e:  # noqa: BLE001 — telemetry is best-effort here
+        print(f"bench_scaling: metrics snapshot failed: {e}",
+              file=sys.stderr, flush=True)
+    print(json.dumps(blob))
 
 
 if __name__ == "__main__":

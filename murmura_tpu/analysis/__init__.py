@@ -48,6 +48,7 @@ def run_check_detailed(
     compose: Optional[bool] = None,
     memory: Optional[bool] = None,
     serve: Optional[bool] = None,
+    observe: Optional[bool] = None,
 ) -> Tuple[List[Finding], List[Dict[str, Any]]]:
     """Run the full static pass and return ``(findings, records)``.
 
@@ -98,13 +99,21 @@ def run_check_detailed(
     jaxpr skeletons are structurally equal — zero recompiles across
     warm-bucket admissions, frozen-lane non-interference under
     eviction, and daemon kill+recover resume completeness with
-    byte-identical histories).
+    byte-identical histories), and when ``observe`` is enabled the
+    observability contracts (analysis/observe.py, MUR1700-1703:
+    metrics↔ledger parity — a daemon scrape equals an independent
+    replay of the durable ledger + event streams — scrape
+    non-interference (polling metrics/ping/list mid-generation causes
+    zero recompiles and byte-identical tenant histories), trace-span
+    well-formedness with phase_times reconciliation, and schema
+    discipline — the v2 event additions carry their migration note and
+    v1 streams still render).
     ``ir=None``/``flow=None``/``durability=None``/``adaptive=None``/
     ``staleness=None``/``pipeline=None``/``sharded=None``/
-    ``compose=None``/``memory=None``/``serve=None`` mean "on for the
-    package check, off for explicit paths" (all ten passes are
-    package-global: they exercise the live registry, not the files
-    named on the command line).
+    ``compose=None``/``memory=None``/``serve=None``/``observe=None``
+    mean "on for the package check, off for explicit paths" (all eleven
+    passes are package-global: they exercise the live registry, not the
+    files named on the command line).
 
     ``records`` carries machine-readable non-finding rows for
     ``check --json``: one ``{"kind": "budget_delta", ...}`` per budget
@@ -127,6 +136,7 @@ def run_check_detailed(
     run_compose = compose if compose is not None else not paths
     run_memory = memory if memory is not None else not paths
     run_serve = serve if serve is not None else not paths
+    run_observe = observe if observe is not None else not paths
     if not paths:
         paths = [Path(__file__).resolve().parent.parent]
     findings = list(lint_paths(paths))
@@ -180,6 +190,10 @@ def run_check_detailed(
         from murmura_tpu.analysis import serve as serve_mod
 
         findings.extend(serve_mod.check_serve())
+    if run_observe:
+        from murmura_tpu.analysis import observe as observe_mod
+
+        findings.extend(observe_mod.check_observe())
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     return findings, records
 
@@ -197,6 +211,7 @@ def run_check(
     compose: Optional[bool] = None,
     memory: Optional[bool] = None,
     serve: Optional[bool] = None,
+    observe: Optional[bool] = None,
 ) -> List[Finding]:
     """Findings-only wrapper of :func:`run_check_detailed` (the historical
     API; empty result means clean)."""
@@ -204,6 +219,7 @@ def run_check(
         paths, contracts=contracts, ir=ir, flow=flow, durability=durability,
         adaptive=adaptive, staleness=staleness, pipeline=pipeline,
         sharded=sharded, compose=compose, memory=memory, serve=serve,
+        observe=observe,
     )[0]
 
 

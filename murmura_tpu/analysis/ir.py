@@ -128,7 +128,8 @@ IR_CHECK_FAMILIES: Dict[str, Tuple[Callable, str, str]] = {}
 _CHECK_ENTRY_POINTS = frozenset(
     {"check_ir", "check_coverage", "check_flow", "check_durability",
      "check_adaptive", "check_staleness", "check_pipeline",
-     "check_sharded", "check_composition", "check_memory", "check_serve"}
+     "check_sharded", "check_composition", "check_memory", "check_serve",
+     "check_observe"}
 )
 
 
@@ -1702,6 +1703,13 @@ def check_coverage() -> List[Finding]:
     findings.extend(
         _unwired_family_findings(
             serve_check_mod, serve_check_mod.SERVE_CHECK_FAMILIES
+        )
+    )
+    from murmura_tpu.analysis import observe as observe_mod
+
+    findings.extend(
+        _unwired_family_findings(
+            observe_mod, observe_mod.OBSERVE_CHECK_FAMILIES
         )
     )
     return findings

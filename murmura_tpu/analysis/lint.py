@@ -154,6 +154,13 @@ RULES: Dict[str, str] = {
     "MUR1601": "serve-admission-recompile",
     "MUR1602": "serve-frozen-lane",
     "MUR1603": "serve-resume-completeness",
+    # 17xx = observability contracts (analysis/observe.py,
+    # `check --observe`; docs/OBSERVABILITY.md "The fleet observability
+    # plane")
+    "MUR1700": "metrics-ledger-parity",
+    "MUR1701": "scrape-non-interference",
+    "MUR1702": "span-well-formedness",
+    "MUR1703": "observability-schema-discipline",
 }
 
 

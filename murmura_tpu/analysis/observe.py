@@ -1,0 +1,482 @@
+"""MUR1700-1703: the observability contracts (`murmura check --observe`;
+docs/OBSERVABILITY.md "The fleet observability plane").
+
+The observability plane (ISSUE 19) is only trustworthy if it is both
+*honest* (a scrape never shows numbers the durable artifacts cannot
+reproduce) and *inert* (watching a daemon cannot perturb its tenants).
+Four executable probes on tiny-but-real cells:
+
+- **MUR1700 — metrics↔ledger parity.**  Scrape an in-process daemon
+  after a drained generation and independently replay the durable
+  state (ledger records re-read from disk, event streams re-counted
+  line by line): every scraped counter must equal the replay.
+  Negative-tested by dropping an event after the scrape
+  (tests/test_observability.py).
+- **MUR1701 — scrape non-interference.**  Run a warm bucket's second
+  generation under :class:`CompileTracker` while a polling thread
+  hammers the read ops (metrics/ping/list): zero compiles, and every
+  tenant history byte-identical to an unscraped reference daemon's —
+  the MUR1602 pattern applied to observation instead of eviction.
+- **MUR1702 — span well-formedness.**  Build trace spans from a real
+  drained tenant stream: every span closed and parented, per-lane
+  non-overlap, and the round spans summing to the stream's
+  ``phase_times`` total within tolerance (telemetry/spans.py
+  :func:`validate_spans` is the shared predicate the tests negative-
+  test with doctored spans).
+- **MUR1703 — schema discipline.**  The v2 event additions (``t``
+  timestamps, ``serve`` lifecycle events) bumped
+  ``MANIFEST_SCHEMA_VERSION`` with the MUR401-required migration note,
+  AND a hand-built v1 stream (no ``t``) still renders through the
+  report, the span builder, and the metrics fold.
+
+Executable and compile-bearing (like check_serve), so the sweep is
+memoized per process and runs by default only for the package-level
+check.
+"""
+
+import json
+import shutil
+import tempfile
+import threading
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Tuple
+
+from murmura_tpu.analysis.durability import history_equal
+from murmura_tpu.analysis.lint import Finding
+
+# Registry of check families in this module: name -> callable, scanned by
+# analysis/ir.py's check_coverage so an unwired family is a MUR205
+# finding (the serve.py twin pattern).
+OBSERVE_CHECK_FAMILIES: Dict[str, Callable[[], List[Finding]]] = {}
+
+
+def _family(fn):
+    OBSERVE_CHECK_FAMILIES[fn.__name__] = fn
+    return fn
+
+
+def _anchor(rel_path: str, needle: str) -> Tuple[str, int]:
+    """Finding anchor: the line defining the machinery under contract."""
+    path = Path(__file__).resolve().parents[1] / rel_path
+    try:
+        for i, line in enumerate(
+            path.read_text(encoding="utf-8").splitlines(), 1
+        ):
+            if needle in line:
+                return str(path), i
+    except OSError:
+        pass
+    return str(path), 1
+
+
+def _daemon(state_dir, capacity: int = 2, checkpoint_every: int = 1):
+    from murmura_tpu.analysis.serve import _tenant_raw
+    from murmura_tpu.config import Config
+    from murmura_tpu.serve.daemon import ServeDaemon
+
+    cfg = Config.model_validate({
+        **_tenant_raw(seed=0, rounds=3),
+        "serve": {"state_dir": str(state_dir), "capacity": capacity,
+                  "checkpoint_every": checkpoint_every},
+    })
+    return ServeDaemon(cfg)
+
+
+# --------------------------------------------------------------------------
+# MUR1700 — metrics <-> ledger parity
+# --------------------------------------------------------------------------
+
+
+def metrics_ledger_parity(daemon, text: Optional[str] = None) -> List[str]:
+    """Compare a scrape against an INDEPENDENT replay of durable state;
+    returns human-readable discrepancies (empty = parity).
+
+    The replay deliberately bypasses the registry fold: ledger records
+    are re-read from disk and event streams re-counted line by line, so
+    a fold bug, a dropped event, or a doctored counter all surface.
+    ``text`` lets callers check a scrape taken earlier (the dropped-
+    event negative test scrapes, mutates the stream, then re-checks)."""
+    from murmura_tpu.telemetry.metrics import (
+        parse_openmetrics,
+        render_openmetrics,
+    )
+
+    if text is None:
+        text = render_openmetrics(daemon.metrics_registry())
+    parsed = parse_openmetrics(text)
+    problems: List[str] = []
+
+    def scraped(name: str, **labels) -> Optional[float]:
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        return parsed.get((name, key))
+
+    # Ledger replay: records re-read from disk, not the in-memory dict.
+    records = []
+    for path in sorted((daemon.state_dir / "submissions").glob("*.json")):
+        records.append(json.loads(path.read_text(encoding="utf-8")))
+    got = scraped("murmura_serve_lifetime_total", counter="admissions")
+    if got != len(records):
+        problems.append(
+            f"scraped admissions={got} but the durable ledger holds "
+            f"{len(records)} submission records"
+        )
+    states: Dict[str, int] = {}
+    for rec in records:
+        states[rec["state"]] = states.get(rec["state"], 0) + 1
+    for state, count in sorted(states.items()):
+        got = scraped("murmura_serve_submissions", state=state)
+        if got != count:
+            problems.append(
+                f"scraped submissions{{state={state}}}={got} but the "
+                f"ledger replay counts {count}"
+            )
+    # Event-stream replay: raw line counts per tenant, no shared reader.
+    for rec in records:
+        run_dir = daemon.state_dir / "telemetry" / rec["id"]
+        events_path = run_dir / "events.jsonl"
+        if not events_path.exists():
+            continue
+        rounds = 0
+        lifecycle: Dict[str, int] = {}
+        for line in events_path.read_text(encoding="utf-8").splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                event = json.loads(line)
+            except json.JSONDecodeError:
+                break  # torn tail: the valid prefix is the stream
+            if event.get("type") == "round":
+                rounds += 1
+            elif event.get("type") == "serve":
+                name = str(event.get("event"))
+                lifecycle[name] = lifecycle.get(name, 0) + 1
+        got = scraped("murmura_rounds_total", tenant=rec["id"])
+        if (got or 0) != rounds:
+            problems.append(
+                f"scraped rounds_total{{tenant={rec['id']}}}={got} but the "
+                f"stream replay counts {rounds} round events"
+            )
+        for name, count in sorted(lifecycle.items()):
+            got = scraped(
+                "murmura_serve_events_total", tenant=rec["id"], event=name,
+            )
+            if (got or 0) != count:
+                problems.append(
+                    f"scraped serve_events{{tenant={rec['id']}, "
+                    f"event={name}}}={got} but the stream replay counts "
+                    f"{count}"
+                )
+    return problems
+
+
+@_family
+def check_metrics_parity() -> List[Finding]:
+    """MUR1700: a drained daemon's scrape equals the durable replay."""
+    from murmura_tpu.analysis.serve import _tenant_raw
+
+    path, line = _anchor("serve/daemon.py", "def metrics_registry")
+    findings: List[Finding] = []
+    tmp = Path(tempfile.mkdtemp(prefix="murmura-observe-1700-"))
+    try:
+        daemon = _daemon(tmp / "state")
+        daemon.submit_config(_tenant_raw(seed=5))
+        daemon.submit_config(_tenant_raw(seed=6))
+        daemon.drain()
+        for problem in metrics_ledger_parity(daemon):
+            findings.append(Finding(
+                "MUR1700", path, line,
+                f"metrics scrape disagrees with the durable replay: "
+                f"{problem} — a scraped counter must be reconstructible "
+                "from the ledger + event streams alone",
+            ))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MUR1701 — scrape non-interference
+# --------------------------------------------------------------------------
+
+
+def interference_problems(
+    compiles: int,
+    history_pairs: List[Tuple[str, dict, dict]],
+) -> List[str]:
+    """The MUR1701 verdict: ``compiles`` observed during the scraped
+    generation and (sub_id, scraped_history, reference_history) pairs.
+    Shared with the negative tests."""
+    problems: List[str] = []
+    if compiles:
+        problems.append(
+            f"{compiles} XLA compilation(s) during the scraped "
+            "generation — the read ops must not touch compiled state"
+        )
+    for sub_id, scraped_hist, ref_hist in history_pairs:
+        if not history_equal(scraped_hist, ref_hist):
+            problems.append(
+                f"tenant {sub_id}'s history diverges from the unscraped "
+                "reference — observation perturbed the computation"
+            )
+    return problems
+
+
+@_family
+def check_scrape_noninterference() -> List[Finding]:
+    """MUR1701: a metrics/ping/list polling loop against a running
+    daemon causes zero recompiles and leaves tenant histories
+    byte-identical to an unscraped reference."""
+    from murmura_tpu.analysis.sanitizers import track_compiles
+    from murmura_tpu.analysis.serve import _tenant_raw
+
+    path, line = _anchor("serve/daemon.py", "def handle_request")
+    findings: List[Finding] = []
+    tmp = Path(tempfile.mkdtemp(prefix="murmura-observe-1701-"))
+    try:
+        def run(state: Path, scrape: bool) -> dict:
+            daemon = _daemon(state)
+            daemon.submit_config(_tenant_raw(seed=5))
+            daemon.submit_config(_tenant_raw(seed=6))
+            daemon.drain()  # generation 1 warms the bucket's one compile
+            gen2_ids = [
+                daemon.submit_config(_tenant_raw(seed=7))["id"],
+                daemon.submit_config(_tenant_raw(seed=8))["id"],
+            ]
+            stop = threading.Event()
+
+            def poll():
+                while not stop.is_set():
+                    daemon.handle_request({"op": "metrics"})
+                    daemon.handle_request({"op": "ping"})
+                    daemon.handle_request({"op": "list"})
+
+            poller = None
+            if scrape:
+                poller = threading.Thread(target=poll, daemon=True)
+                poller.start()
+            try:
+                with track_compiles() as tracker:
+                    daemon.drain()  # generation 2: must stay warm
+            finally:
+                stop.set()
+                if poller is not None:
+                    poller.join(timeout=10.0)
+            return {
+                "compiles": tracker.total,
+                "ledger": {i: daemon._ledger[i] for i in gen2_ids},
+            }
+
+        ref = run(tmp / "ref", scrape=False)
+        scraped = run(tmp / "scraped", scrape=True)
+        pairs = [
+            (i,
+             scraped["ledger"][i].get("history"),
+             ref["ledger"][i].get("history"))
+            for i in sorted(ref["ledger"])
+        ]
+        for problem in interference_problems(scraped["compiles"], pairs):
+            findings.append(Finding(
+                "MUR1701", path, line,
+                f"scrape non-interference violated: {problem} (polling "
+                "metrics/ping/list mid-generation must be invisible to "
+                "tenants — the MUR1602 pattern for observation)",
+            ))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MUR1702 — span well-formedness
+# --------------------------------------------------------------------------
+
+
+@_family
+def check_span_wellformedness() -> List[Finding]:
+    """MUR1702: spans built from a real drained tenant stream are
+    closed, parented, per-lane non-overlapping, and their round lane
+    sums to the stream's phase_times total."""
+    from murmura_tpu.analysis.serve import _tenant_raw
+    from murmura_tpu.telemetry.spans import (
+        LANE_ROUNDS,
+        build_spans,
+        validate_spans,
+    )
+    from murmura_tpu.telemetry.writer import events_of_type
+
+    path, line = _anchor("telemetry/spans.py", "def build_spans")
+    findings: List[Finding] = []
+    tmp = Path(tempfile.mkdtemp(prefix="murmura-observe-1702-"))
+    try:
+        daemon = _daemon(tmp / "state")
+        daemon.submit_config(_tenant_raw(seed=5))
+        daemon.submit_config(_tenant_raw(seed=6))
+        daemon.drain()
+        for sub_id in sorted(daemon._ledger):
+            run_dir = daemon.state_dir / "telemetry" / sub_id
+            spans = build_spans(run_dir)
+            phase_events = events_of_type(run_dir, "phase_times")
+            phase_total = sum(float(e.get("wall_s", 0.0))
+                              for e in phase_events)
+            for problem in validate_spans(spans, phase_total=phase_total):
+                findings.append(Finding(
+                    "MUR1702", path, line,
+                    f"tenant {sub_id}: {problem}",
+                ))
+            round_spans = [s for s in spans if s["tid"] == LANE_ROUNDS]
+            if len(round_spans) != len(phase_events):
+                findings.append(Finding(
+                    "MUR1702", path, line,
+                    f"tenant {sub_id}: {len(round_spans)} round spans for "
+                    f"{len(phase_events)} phase_times events — every "
+                    "accounted round must appear in the trace",
+                ))
+            names = {s["name"] for s in spans}
+            for required in ("run", "queued", "generation"):
+                if required not in names:
+                    findings.append(Finding(
+                        "MUR1702", path, line,
+                        f"tenant {sub_id}: no {required!r} span — the "
+                        "serve lifecycle must be visible in the trace",
+                    ))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# MUR1703 — schema discipline
+# --------------------------------------------------------------------------
+
+
+def schema_discipline_problems(version: int, docs_text: str) -> List[str]:
+    """The static half of MUR1703, shared with the negative tests."""
+    problems: List[str] = []
+    if version < 2:
+        problems.append(
+            f"MANIFEST_SCHEMA_VERSION is {version} but the v2 event "
+            "additions (per-event `t`, `serve` lifecycle events) are in "
+            "the stream — new event types require a schema bump"
+        )
+    if f"### v{version}" not in docs_text:
+        problems.append(
+            f"docs/OBSERVABILITY.md has no '### v{version}' migration "
+            "note for the current schema (the MUR401 discipline)"
+        )
+    return problems
+
+
+@_family
+def check_schema_discipline() -> List[Finding]:
+    """MUR1703: the v2 bump carries its migration note AND a v1 stream
+    (no per-event ``t``) still renders through the report, the span
+    builder, and the metrics fold."""
+    from murmura_tpu.telemetry.metrics import MetricsRegistry, fold_run_events
+    from murmura_tpu.telemetry.report import build_report
+    from murmura_tpu.telemetry.schema import MANIFEST_SCHEMA_VERSION
+    from murmura_tpu.telemetry.spans import build_spans, validate_spans
+
+    path, line = _anchor("telemetry/schema.py", "MANIFEST_SCHEMA_VERSION =")
+    findings: List[Finding] = []
+    docs = Path(__file__).resolve().parents[2] / "docs" / "OBSERVABILITY.md"
+    try:
+        docs_text = docs.read_text(encoding="utf-8")
+    except OSError:
+        docs_text = ""
+    for problem in schema_discipline_problems(
+        MANIFEST_SCHEMA_VERSION, docs_text,
+    ):
+        findings.append(Finding("MUR1703", path, line, problem))
+
+    # Old streams still render: a hand-built v1 run (no `t` anywhere).
+    tmp = Path(tempfile.mkdtemp(prefix="murmura-observe-1703-"))
+    try:
+        run_dir = tmp / "v1run"
+        run_dir.mkdir(parents=True)
+        (run_dir / "manifest.json").write_text(json.dumps({
+            "schema_version": 1, "kind": "run", "run_id": "v1-probe",
+            "created_unix": 1000.0, "finalized": True,
+            "finalized_unix": 1004.0, "counters": {},
+            "history": {"round": [1, 2], "mean_accuracy": [0.5, 0.6],
+                        "mean_loss": [1.0, 0.9]},
+        }))
+        v1_events = [
+            {"type": "run", "seq": 0, "status": "started"},
+            {"type": "round", "seq": 1, "round": 1,
+             "metrics": {"accuracy": [0.5]}},
+            {"type": "phase_times", "seq": 2, "round": 0,
+             "mode": "per_round", "wall_s": 0.5},
+            {"type": "round", "seq": 3, "round": 2,
+             "metrics": {"accuracy": [0.6]}},
+            {"type": "phase_times", "seq": 4, "round": 1,
+             "mode": "per_round", "wall_s": 0.5},
+        ]
+        (run_dir / "events.jsonl").write_text(
+            "".join(json.dumps(e) + "\n" for e in v1_events)
+        )
+        try:
+            build_report(run_dir)
+            spans = build_spans(run_dir)
+            problems = validate_spans(spans, phase_total=1.0)
+            if problems:
+                findings.append(Finding(
+                    "MUR1703", path, line,
+                    "a v1 stream (no per-event `t`) builds malformed "
+                    f"spans: {problems[0]} — old streams must still "
+                    "render after the v2 bump",
+                ))
+            reg = MetricsRegistry()
+            fold_run_events(reg, run_dir)
+            if reg.value("murmura_rounds") != 2:
+                findings.append(Finding(
+                    "MUR1703", path, line,
+                    "the metrics fold miscounts a v1 stream "
+                    f"({reg.value('murmura_rounds')} rounds for 2 round "
+                    "events) — old streams must still fold",
+                ))
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(Finding(
+                "MUR1703", path, line,
+                f"rendering a v1 stream crashed ({type(e).__name__}: {e}) "
+                "— the v2 readers must tolerate v1 artifacts",
+            ))
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Entry point
+# --------------------------------------------------------------------------
+
+_OBSERVE_MEMO: Optional[List[Finding]] = None
+
+
+def check_observe(force: bool = False) -> List[Finding]:
+    """Run MUR1700-1703; returns findings (empty = scrapes are honest
+    replays of durable state, observation is invisible to tenants,
+    traces are well-formed and reconcile with phase accounting, and the
+    schema bump is disciplined).  Memoized per process; compile-bearing,
+    so it runs by default only for the package-level check (like
+    check_serve)."""
+    global _OBSERVE_MEMO
+    if _OBSERVE_MEMO is not None and not force:
+        return list(_OBSERVE_MEMO)
+
+    from murmura_tpu.analysis.ir import _apply_suppressions
+
+    findings: List[Finding] = []
+    for fam_name, fam in OBSERVE_CHECK_FAMILIES.items():
+        try:
+            findings.extend(fam())
+        except Exception as e:  # noqa: BLE001 — a crash IS the finding
+            findings.append(Finding(
+                "MUR1700", str(Path(__file__).resolve()), 1,
+                f"observe check family '{fam_name}' crashed: "
+                f"{type(e).__name__}: {e}",
+            ))
+    findings = _apply_suppressions(list(dict.fromkeys(findings)))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    _OBSERVE_MEMO = list(findings)
+    return findings

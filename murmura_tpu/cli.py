@@ -881,6 +881,19 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "off when explicit PATHS are given.",
 )
 @click.option(
+    "--observe/--no-observe", "observe_checks", default=None,
+    help="Run the observability contracts (MUR1700-1703: metrics↔ledger "
+         "parity — a daemon scrape equals an independent replay of the "
+         "durable ledger + event streams — scrape non-interference "
+         "(polling metrics/ping/list mid-generation causes zero "
+         "recompiles and byte-identical tenant histories), trace-span "
+         "well-formedness with phase_times reconciliation, and schema "
+         "discipline — v2 events carry their migration note and v1 "
+         "streams still render).  Compiles and runs in-process daemons "
+         "(~1 min on CPU).  Default: on for the package check, off when "
+         "explicit PATHS are given.",
+)
+@click.option(
     "--json", "as_json", is_flag=True, default=False,
     help="Emit findings (and budget-delta / flow-summary / "
          "compose-summary / memory-summary records) as JSON lines for "
@@ -897,8 +910,8 @@ def run_node(config_path: Path, node_id, t_start, run_id, host, resume):
          "analysis/MEMORY.json; review the diff as residency history.",
 )
 def check(paths, contracts, ir, flow, durability, adaptive, staleness,
-          pipeline, sharded, compose, memory, serve_checks, as_json,
-          update_budgets, update_memory):
+          pipeline, sharded, compose, memory, serve_checks, observe_checks,
+          as_json, update_budgets, update_memory):
     """JAX-aware static analysis over PATHS (default: the installed
     murmura_tpu package).
 
@@ -915,8 +928,9 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     pipelined-rounds contracts (MUR1200-1203 via --pipeline), the
     param-axis sharding contracts (MUR1300-1303 via --sharded), the
     cross-feature composition grid (MUR1400-1403 via --compose), the
-    static memory contracts (MUR1500-1503 via --memory), and the serving
-    contracts (MUR1600-1603 via --serve).
+    static memory contracts (MUR1500-1503 via --memory), the serving
+    contracts (MUR1600-1603 via --serve), and the observability
+    contracts (MUR1700-1703 via --observe).
     Exits non-zero when any finding survives suppression.  See
     docs/ANALYSIS.md for the rule catalogue and the
     ``# murmura: ignore[...]`` suppression syntax.
@@ -949,7 +963,7 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
         list(paths) or None, contracts=contracts, ir=ir, flow=flow,
         durability=durability, adaptive=adaptive, staleness=staleness,
         pipeline=pipeline, sharded=sharded, compose=compose, memory=memory,
-        serve=serve_checks,
+        serve=serve_checks, observe=observe_checks,
     )
     if as_json:
         out = format_findings_json(findings, records)
@@ -994,8 +1008,23 @@ def check(paths, contracts, ir, flow, durability, adaptive, staleness,
     help="Emit the report as one JSON object (machine-readable; the same "
          "dict the tables render) instead of rich tables.",
 )
+@click.option(
+    "--latest", "latest", is_flag=True, default=False,
+    help="Report the newest run found under the current directory "
+         "(telemetry_runs/, serve state dirs) instead of naming RUN_DIR — "
+         "the `murmura runs` index picks it.",
+)
+@click.option(
+    "--trace", "trace_path", default=None,
+    type=click.Path(dir_okay=False, path_type=Path),
+    help="Instead of tables, export the run's trace spans (submit→admit→"
+         "generation→round, built from the event stream's wall-clock "
+         "timestamps) as Chrome trace-event JSON — open in Perfetto "
+         "(ui.perfetto.dev) or chrome://tracing.",
+)
 def report(run_dir: Optional[Path], frontier_path: Optional[Path],
-           grid_path: Optional[Path], as_json: bool):
+           grid_path: Optional[Path], as_json: bool, latest: bool,
+           trace_path: Optional[Path]):
     """Render a telemetry run directory (manifest.json + events.jsonl),
     or — with ``--frontier`` / ``--grid`` — a frontier artifact or a
     grid scheduler manifest.
@@ -1047,12 +1076,38 @@ def report(run_dir: Optional[Path], frontier_path: Optional[Path],
         else:
             render_grid(artifact, console=console)
         return
+    if run_dir is None and latest:
+        from murmura_tpu.telemetry.registry import find_latest
+
+        row = find_latest([Path(".")])
+        if row is None:
+            console.print(
+                "[bold red]--latest: no telemetry runs found under the "
+                "current directory[/bold red]"
+            )
+            raise SystemExit(1)
+        run_dir = Path(row["path"])
+        console.print(f"[dim]latest: {run_dir}[/dim]")
     if run_dir is None:
         console.print(
-            "[bold red]murmura report needs a RUN_DIR (or "
+            "[bold red]murmura report needs a RUN_DIR (or --latest, "
             "--frontier <frontier.json> / --grid <grid.json>)[/bold red]"
         )
         raise SystemExit(1)
+    if trace_path is not None:
+        from murmura_tpu.telemetry.spans import write_chrome_trace
+
+        try:
+            n = write_chrome_trace(trace_path, [run_dir])
+        except FileNotFoundError as e:
+            console.print(f"[bold red]{escape(str(e))}[/bold red]")
+            raise SystemExit(1)
+        console.print(
+            f"wrote [bold]{n}[/bold] trace span(s) to "
+            f"[bold]{trace_path}[/bold] — open in Perfetto "
+            "(ui.perfetto.dev) or chrome://tracing"
+        )
+        return
     from murmura_tpu.telemetry.report import build_report, render_report
 
     try:
@@ -1065,6 +1120,106 @@ def report(run_dir: Optional[Path], frontier_path: Optional[Path],
     except FileNotFoundError as e:
         console.print(f"[bold red]{escape(str(e))}[/bold red]")
         raise SystemExit(1)
+
+
+@app.command()
+@click.argument("target", type=click.Path(exists=True, path_type=Path))
+def metrics(target: Path):
+    """Render a run's metrics as OpenMetrics text (ISSUE 19 leg 1).
+
+    TARGET is either a running daemon's unix socket (the live
+    ``{"op": "metrics"}`` scrape — read-only, recompile-free, MUR1701)
+    or a telemetry run directory (the same registry folded offline from
+    manifest.json + events.jsonl — batch and serve runs scrape
+    identically).  Pipe to any OpenMetrics/Prometheus scraper, or diff
+    two snapshots by eye.
+    """
+    import stat
+
+    from murmura_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        fold_run_events,
+        render_openmetrics,
+        scrape_socket,
+    )
+
+    if stat.S_ISSOCK(target.stat().st_mode):
+        try:
+            click.echo(scrape_socket(str(target)))
+        except (OSError, RuntimeError) as e:
+            console.print(f"[bold red]{escape(str(e))}[/bold red]")
+            raise SystemExit(1)
+        return
+    if not target.is_dir():
+        console.print(
+            "[bold red]murmura metrics needs a daemon socket or a "
+            "telemetry run directory[/bold red]"
+        )
+        raise SystemExit(1)
+    reg = MetricsRegistry()
+    fold_run_events(reg, target)
+    click.echo(render_openmetrics(reg))
+
+
+@app.command()
+@click.option("--socket", "socket_path", required=True,
+              type=click.Path(exists=True, path_type=Path),
+              help="The daemon's unix socket (serve.socket / "
+                   "<state_dir>/daemon.sock)")
+@click.option("--interval", "interval_s", type=float, default=1.0,
+              show_default=True, help="Refresh interval in seconds")
+@click.option("--iterations", type=int, default=None,
+              help="Stop after N refreshes (default: until Ctrl-C)")
+def top(socket_path: Path, interval_s: float, iterations):
+    """Live daemon dashboard off the read-only ops (ISSUE 19 leg 2).
+
+    Refreshes a tenant table (state / round progress / accuracy / mean
+    round time), warm-bucket occupancy, the cumulative daemon counters
+    (admissions, evictions, resumes, compiles, generations), and the
+    snapshot age — entirely from the ping/list/metrics protocol ops, so
+    watching a daemon never perturbs it (MUR1701).
+    """
+    from murmura_tpu.telemetry.top import run_top
+
+    try:
+        run_top(
+            str(socket_path), interval_s=interval_s, iterations=iterations,
+            echo=click.echo,
+        )
+    except KeyboardInterrupt:
+        pass
+    except (OSError, RuntimeError) as e:
+        console.print(f"[bold red]{escape(str(e))}[/bold red]")
+        raise SystemExit(1)
+
+
+@app.command()
+@click.argument(
+    "roots", nargs=-1, type=click.Path(exists=True, path_type=Path)
+)
+@click.option("--json", "as_json", is_flag=True, default=False,
+              help="Emit one JSON object per indexed run (JSON lines)")
+def runs(roots, as_json: bool):
+    """Cross-run registry: index every telemetry artifact under ROOTS
+    (default: the current directory) — ``telemetry_runs/``, serve state
+    dirs, bench manifests (ISSUE 19 leg 3).
+
+    One row per run/submission: kind, schema version, platform, rounds,
+    best accuracy, terminal state, and whether the event stream has a
+    torn tail (a crash mid-append).  Newest first; ``murmura report
+    --latest`` renders the top row.
+    """
+    from murmura_tpu.telemetry.registry import index_runs, render_rows
+
+    rows = index_runs([Path(r) for r in roots] or [Path(".")])
+    if as_json:
+        for row in rows:
+            click.echo(json.dumps(row))
+        return
+    if not rows:
+        console.print("no telemetry runs found")
+        return
+    click.echo(render_rows(rows))
 
 
 @app.command("list-components")

@@ -511,6 +511,12 @@ class GangNetwork:
         use (durability/snapshot.py), so an interrupted sweep resumes all
         S members byte-identically (`murmura sweep --resume`).
         """
+        from murmura_tpu.analysis.sanitizers import CompileTracker
+
+        # Independent of the recompile guard: a passive process-wide
+        # baseline so every member's manifest carries the compiles this
+        # train() call paid (the metrics fold's `counter="compiles"`).
+        compile_probe = CompileTracker()
         try:
             with self._sanitizer_scope():
                 if rounds_per_dispatch > 1:
@@ -524,8 +530,11 @@ class GangNetwork:
                         checkpoint_every,
                     )
         finally:
+            compiled = compile_probe.total
             for s, t in enumerate(self.telemetry):
                 if t is not None:
+                    if compiled:
+                        t.add_counters({"compiles": compiled})
                     t.finalize(history=self.histories[s])
         return self.histories
 

@@ -561,9 +561,16 @@ class Network:
                 Checkpoints land on chunk boundaries.  1 = per-round
                 dispatch (default).
         """
+        from murmura_tpu.analysis.sanitizers import CompileTracker
+
         profile = self.profile_dir is not None
         if profile:
             jax.profiler.start_trace(self.profile_dir)
+        # Passive compile accounting independent of the recompile guard:
+        # the manifest's `compiles` counter feeds the offline metrics fold
+        # (telemetry/metrics.py), so a scrape can surface recompile churn
+        # without arming the raising sanitizer.
+        compile_probe = CompileTracker()
         try:
             with self._sanitizer_scope():
                 if rounds_per_dispatch > 1:
@@ -593,6 +600,9 @@ class Network:
             # view even across checkpoint/resume segments.
             self._profile_window_stop(self.current_round, force=True)
             if self.telemetry is not None:
+                compiled = compile_probe.total
+                if compiled:
+                    self.telemetry.add_counters({"compiles": compiled})
                 self.telemetry.finalize(history=self.history)
         return self.history
 

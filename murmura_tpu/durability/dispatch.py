@@ -141,6 +141,36 @@ def backoff_delays(policy: RetryPolicy) -> Iterator[float]:
         yield base * (1.0 + rng.uniform(-policy.jitter, policy.jitter))
 
 
+class RetryStats:
+    """Mutable retry accounting for one dispatch envelope.
+
+    The observability plane's view of the retry loop (ISSUE 19): pass
+    :meth:`hook` as ``run_with_retry(on_retry=...)`` (or chain it from
+    an existing hook) and the envelope's transient retries and
+    cumulative backoff become scrapeable — the offline fold turns the
+    matching ``backend_degraded`` events into
+    ``murmura_degradations``/``murmura_backoff_seconds``
+    (telemetry/metrics.py)."""
+
+    def __init__(self):
+        self.retries = 0
+        self.backoff_s = 0.0
+        self.last_reason: Optional[str] = None
+
+    def hook(self, exc: BaseException, try_idx: int, delay: float) -> None:
+        self.retries += 1
+        self.backoff_s += float(delay)
+        self.last_reason = f"{type(exc).__name__}: {exc}"
+
+    def counters(self) -> dict:
+        """The accumulated totals, keyed for
+        ``TelemetryWriter.add_counters`` / the manifest counter fold."""
+        return {
+            "dispatch_retries": self.retries,
+            "dispatch_backoff_s": self.backoff_s,
+        }
+
+
 def run_with_retry(
     attempt: Callable[[int], object],
     *,

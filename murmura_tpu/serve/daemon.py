@@ -51,6 +51,7 @@ import numpy as np
 from murmura_tpu.config.schema import Config
 from murmura_tpu.durability.dispatch import (
     RetryPolicy,
+    RetryStats,
     classify_error,
     run_with_retry,
 )
@@ -146,6 +147,16 @@ class ServeDaemon:
         self._listener: Optional[threading.Thread] = None
         self._server = None
         self._seq = 0
+        # Observability plane (ISSUE 19): process-lifetime cumulative
+        # counters (ping/top header + the metrics op) and the live
+        # TelemetryWriter of each currently-running tenant, so eviction
+        # can land a lifecycle event in the tenant's own stream.
+        self.started_at = time.time()
+        self._counters: Dict[str, int] = {
+            "admissions": 0, "evictions": 0, "resumes": 0,
+            "compiles": 0, "generations": 0,
+        }
+        self._tenant_writers: Dict[str, Any] = {}
         self._load_ledger()
 
     # ------------------------------------------------------------------
@@ -203,6 +214,7 @@ class ServeDaemon:
             self._ledger[sub_id] = rec
             self._write_record(rec)
             self._pending.append(sub_id)
+            self._counters["admissions"] += 1
         return dict(rec)
 
     def evict(self, sub_id: str, reason: str = "evicted") -> Dict[str, Any]:
@@ -221,6 +233,13 @@ class ServeDaemon:
                 bucket = self._buckets.get(rec["fingerprint"])
                 if bucket is not None and rec.get("lane") is not None:
                     bucket["gang"].freeze_member(int(rec["lane"]), reason)
+            writer = self._tenant_writers.get(sub_id)
+            if writer is not None:
+                writer.serve_event(
+                    "evicted", reason=reason, gen=rec.get("gen"),
+                    lane=rec.get("lane"),
+                )
+            self._counters["evictions"] += 1
             return dict(self._update(sub_id, state="evicted", error=reason))
 
     # ------------------------------------------------------------------
@@ -349,6 +368,25 @@ class ServeDaemon:
         writers = [
             self._writer(i, cfg, resume=resume) for i, cfg in tenants
         ]
+        # Lifecycle events through each tenant's OWN stream (ISSUE 19
+        # satellite): the trace/report side of the ledger transitions.
+        # ``submitted`` is backdated to the ledger's submitted_at — the
+        # writer only exists from admission, but the queue time is real.
+        compile_baseline = self._compile_count()
+        for lane, ((sub_id, _cfg), w) in enumerate(zip(tenants, writers)):
+            rec = self._ledger[sub_id]
+            if not resume:
+                w.serve_event("submitted", _t=rec.get("submitted_at"),
+                              bucket=fp)
+                w.serve_event("admitted", bucket=fp, gen=gen, lane=lane)
+            else:
+                w.serve_event("resumed", bucket=fp, gen=gen, lane=lane)
+            w.serve_event("generation_start", gen=gen, lane=lane)
+        with self._lock:
+            if resume:
+                self._counters["resumes"] += len(ids)
+            self._counters["generations"] += 1
+            self._tenant_writers.update(zip(ids, writers))
         gang.reset_run(
             members, member_programs=progs, telemetry_writers=writers,
         )
@@ -371,28 +409,48 @@ class ServeDaemon:
                 )
             return gang.histories
 
+        retry_stats = RetryStats()
+
+        def on_retry(exc, try_idx, delay):
+            # The envelope's degradations land in every tenant stream —
+            # the dispatch-retry leg of the metrics fold.
+            retry_stats.hook(exc, try_idx, delay)
+            for w in writers:
+                w.emit(
+                    "backend_degraded", kind="retry",
+                    reason=retry_stats.last_reason, retry=try_idx,
+                    delay_s=delay,
+                )
+
         try:
             histories = run_with_retry(
                 attempt,
                 policy=RetryPolicy(max_retries=2, base_delay_s=0.1,
                                    max_delay_s=1.0, seed=0),
                 classify=classify_error,
+                on_retry=on_retry,
             )
         except Exception as e:  # noqa: BLE001 — per-tenant fate recording
-            for sub_id in ids:
+            for sub_id, w in zip(ids, writers):
                 if self._ledger[sub_id]["state"] == "running":
                     self._update(
                         sub_id, state="failed",
                         error=f"{type(e).__name__}: {e}",
                     )
-            with self._lock:
-                bucket["gen"] = max(bucket["gen"], gen)
-                bucket["lanes"] = {}
+                w.serve_event(
+                    "generation_done", gen=gen,
+                    outcome=self._ledger[sub_id]["state"],
+                )
+            self._finish_generation(
+                fp, gen, ids, writers, compile_baseline, retry_stats,
+            )
             return
 
         for lane, sub_id in enumerate(ids):
             if self._ledger[sub_id]["state"] != "running":
-                continue  # evicted mid-generation: its state is terminal
+                # Evicted mid-generation: its state is terminal and its
+                # eviction event already landed in the stream.
+                continue
             hist = histories[lane]
             mean = hist.get("mean_accuracy") or []
             honest = hist.get("honest_accuracy") or mean
@@ -411,9 +469,47 @@ class ServeDaemon:
                     ),
                 },
             )
+            writers[lane].serve_event(
+                "generation_done", gen=gen, outcome="done",
+            )
+        self._finish_generation(
+            fp, gen, ids, writers, compile_baseline, retry_stats,
+        )
+
+    def _compile_count(self) -> int:
+        """Process-wide backend compile counter (sanitizers.py); 0 when
+        jax has not initialized yet (nothing can have compiled)."""
+        try:
+            from murmura_tpu.analysis.sanitizers import compile_count
+
+            return compile_count()
+        except Exception:  # noqa: BLE001 — accounting must not kill serving
+            return 0
+
+    def _finish_generation(self, fp, gen, ids, writers,
+                           compile_baseline, retry_stats=None) -> None:
+        """Close the generation: fold the compile delta and the dispatch
+        envelope's retry totals into each tenant's manifest, retire the
+        live writers, and advance the bucket."""
+        compiled = max(0, self._compile_count() - compile_baseline)
+        for w in writers:
+            if compiled:
+                w.add_counters({"serve_compiles": compiled})
+            if retry_stats is not None and retry_stats.retries:
+                w.add_counters(retry_stats.counters())
+            try:
+                w.finalize()
+                w.close()
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass
         with self._lock:
-            bucket["gen"] = max(bucket["gen"], gen)
-            bucket["lanes"] = {}
+            self._counters["compiles"] += compiled
+            for sub_id in ids:
+                self._tenant_writers.pop(sub_id, None)
+            bucket = self._buckets.get(fp)
+            if bucket is not None:
+                bucket["gen"] = max(bucket["gen"], gen)
+                bucket["lanes"] = {}
 
     # ------------------------------------------------------------------
     # Crash recovery
@@ -511,13 +607,74 @@ class ServeDaemon:
     # ------------------------------------------------------------------
     # Protocol handler
 
+    def metrics_registry(self):
+        """The daemon's scrape (``{"op": "metrics"}``): cumulative
+        counters + ledger-state census + queue/bucket gauges, then each
+        tenant's durable event stream folded per-tenant.  Everything is
+        a replay of durable state — the MUR1700 parity contract."""
+        from murmura_tpu.telemetry.metrics import (
+            MetricsRegistry,
+            fold_run_events,
+        )
+
+        reg = MetricsRegistry()
+        with self._lock:
+            reg.set_gauge(
+                "murmura_serve_uptime_seconds",
+                time.time() - self.started_at,
+                help="daemon uptime",
+            )
+            reg.set_gauge(
+                "murmura_serve_queue_depth", len(self._pending),
+                help="queued submissions awaiting a generation",
+            )
+            for cname, cval in self._counters.items():
+                reg.inc(
+                    "murmura_serve_lifetime", float(cval),
+                    labels={"counter": cname},
+                    help="cumulative daemon counters (admissions, "
+                         "evictions, resumes, compiles, generations)",
+                )
+            states: Dict[str, int] = {}
+            tenant_ids = []
+            for sub_id, rec in self._ledger.items():
+                states[rec["state"]] = states.get(rec["state"], 0) + 1
+                tenant_ids.append(sub_id)
+            for state, count in sorted(states.items()):
+                reg.set_gauge(
+                    "murmura_serve_submissions", count,
+                    labels={"state": state},
+                    help="ledger census by lifecycle state",
+                )
+            for fp, b in self._buckets.items():
+                reg.set_gauge(
+                    "murmura_serve_bucket_lanes", b["gang"].batch,
+                    labels={"bucket": fp}, help="compiled lane capacity",
+                )
+                reg.set_gauge(
+                    "murmura_serve_bucket_running", len(b["lanes"]),
+                    labels={"bucket": fp}, help="occupied lanes",
+                )
+        for sub_id in tenant_ids:
+            run_dir = self.state_dir / "telemetry" / sub_id
+            if run_dir.exists():
+                fold_run_events(reg, run_dir, labels={"tenant": sub_id})
+        return reg
+
     def handle_request(self, request: Dict[str, Any]) -> Dict[str, Any]:
+        from murmura_tpu import __version__
+        from murmura_tpu.telemetry.schema import MANIFEST_SCHEMA_VERSION
+
         op = request.get("op")
         if op == "ping":
             with self._lock:
                 return {
                     "ok": True,
                     "pid": os.getpid(),
+                    "uptime_s": time.time() - self.started_at,
+                    "version": __version__,
+                    "schema_version": MANIFEST_SCHEMA_VERSION,
+                    "counters": dict(self._counters),
                     "queued": len(self._pending),
                     "buckets": {
                         fp: {
@@ -528,6 +685,15 @@ class ServeDaemon:
                         for fp, b in self._buckets.items()
                     },
                 }
+        if op == "metrics":
+            from murmura_tpu.telemetry.metrics import render_openmetrics
+
+            return {
+                "ok": True,
+                "content_type": "application/openmetrics-text; "
+                                "version=1.0.0; charset=utf-8",
+                "text": render_openmetrics(self.metrics_registry()),
+            }
         if op == "submit":
             rec = self.submit_config(request.get("config"))
             return {
@@ -546,11 +712,20 @@ class ServeDaemon:
                         "id": r["id"],
                         "state": r["state"],
                         "bucket": r["fingerprint"],
+                        "gen": r.get("gen"),
+                        "lane": r.get("lane"),
+                        "rounds": r.get("rounds"),
                         "final_accuracy": r.get("final_accuracy"),
                     }
                     for _, r in sorted(self._ledger.items())
                 ]
-            return {"ok": True, "submissions": rows}
+                counters = dict(self._counters)
+            return {
+                "ok": True,
+                "uptime_s": time.time() - self.started_at,
+                "counters": counters,
+                "submissions": rows,
+            }
         if op == "evict":
             rec = self.evict(
                 request.get("id"), request.get("reason", "evicted"),

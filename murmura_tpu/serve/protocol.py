@@ -10,8 +10,13 @@ Python heap.  Requests:
 - ``{"op": "submit", "config": {...}}`` -> ``{"ok": true, "id": ...,
   "bucket": ...}``
 - ``{"op": "status", "id": ...}`` -> the submission's ledger record
-- ``{"op": "list"}`` -> every submission's summary row
-- ``{"op": "ping"}`` -> liveness + bucket census
+- ``{"op": "list"}`` -> every submission's summary row + cumulative
+  daemon counters
+- ``{"op": "ping"}`` -> liveness, uptime, package/schema versions,
+  cumulative counters, bucket census
+- ``{"op": "metrics"}`` -> the daemon's OpenMetrics scrape
+  (telemetry/metrics.py; ``text`` carries the exposition, read-only —
+  MUR1701 guarantees a polling loop cannot perturb tenants)
 - ``{"op": "shutdown"}`` -> graceful stop after the current generation
 
 Client sends ride :func:`durability.dispatch.run_with_retry` with the

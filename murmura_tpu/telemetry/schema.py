@@ -47,9 +47,22 @@ type            meaning
 ``counter``     distributed-backend node counters folded by the Monitor
                 (reconnects, send retries/failures, skipped frames,
                 checkpoint durations)
+``serve``       (v2) one serve-daemon lifecycle transition of this
+                tenant: ``event`` in submitted / admitted /
+                generation_start / generation_done / evicted / frozen /
+                resumed, with ``bucket``/``gen``/``lane`` context — the
+                stream-side twin of the durable ledger record, so
+                ``murmura report`` and the trace export see the
+                lifecycle without reading daemon internals
 ``extra``       forward-compat: metric keys this version does not know,
                 preserved verbatim under ``extra.*`` instead of dropped
 =============== ==========================================================
+
+Since v2 every event line also carries ``t``, the host wall-clock unix
+timestamp at emit — the anchor the trace-span builder
+(telemetry/spans.py) and the offline metrics fold need.  v1 streams
+(no ``t``) still render everywhere: readers synthesize a timeline from
+the manifest's ``created_unix`` plus cumulative wall time (MUR1703).
 
 Versioning: ``MANIFEST_SCHEMA_VERSION`` bumps on any breaking change to the
 manifest envelope or an event's required fields, and every version must
@@ -57,7 +70,7 @@ have a migration note in docs/OBSERVABILITY.md ("Schema versions") —
 enforced by ``murmura check`` rule MUR401 (analysis/contracts.py).
 """
 
-MANIFEST_SCHEMA_VERSION = 1
+MANIFEST_SCHEMA_VERSION = 2
 
 MANIFEST_FILE = "manifest.json"
 EVENTS_FILE = "events.jsonl"

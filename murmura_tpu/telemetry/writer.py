@@ -143,12 +143,29 @@ class TelemetryWriter:
     # ------------------------------------------------------------------
     # events
 
-    def emit(self, etype: str, **fields) -> None:
-        """Append one event line (flushed whole; crash loses at most one)."""
-        rec = {"type": etype, "seq": self._seq, **_jsonable(fields)}
+    def emit(self, etype: str, _t: Optional[float] = None, **fields) -> None:
+        """Append one event line (flushed whole; crash loses at most one).
+
+        Every line carries ``t``, the emit wall-clock timestamp (schema
+        v2) — the anchor for trace spans and the offline metrics fold.
+        ``_t`` backdates an event whose real time predates the writer
+        (the daemon's retroactive ``submitted`` lifecycle event)."""
+        rec = {
+            "type": etype,
+            "seq": self._seq,
+            "t": float(_t) if _t is not None else time.time(),
+            **_jsonable(fields),
+        }
         self._seq += 1
         self._events.write(json.dumps(rec) + "\n")
         self._events.flush()
+
+    def serve_event(self, event: str, _t: Optional[float] = None,
+                    **context) -> None:
+        """One serve-daemon lifecycle transition (schema v2 ``serve``
+        events: submitted/admitted/generation_start/generation_done/
+        evicted/frozen/resumed) — the stream-side twin of the ledger."""
+        self.emit("serve", _t=_t, event=str(event), **context)
 
     def phase_times(self, round_idx: int, mode: str, wall_s: float, **extra) -> None:
         """One round's time record.  ``mode`` carries the dispatch
@@ -311,6 +328,18 @@ def write_bench_manifest(
         path = w.finalize(summary=payload)
     finally:
         w.close()
+    # Final OpenMetrics snapshot next to the manifest (ISSUE 19): the
+    # same serializer the daemon's ``metrics`` op uses, so batch and
+    # serve artifacts scrape identically.
+    from murmura_tpu.telemetry.metrics import (
+        MetricsRegistry,
+        fold_bench_payload,
+        write_openmetrics_snapshot,
+    )
+
+    reg = MetricsRegistry()
+    fold_bench_payload(reg, name, payload)
+    write_openmetrics_snapshot(run_dir, reg)
     if legacy_path is not None:
         legacy_path = Path(legacy_path)
         legacy_path.parent.mkdir(parents=True, exist_ok=True)

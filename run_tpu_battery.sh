@@ -22,6 +22,7 @@ SHARDED=0
 COMPOSE=0
 MEMORY=0
 SERVE=0
+OBS=0
 while :; do
   case "${1:-}" in
     --chaos) CHAOS=1; shift;;
@@ -37,6 +38,7 @@ while :; do
     --compose) COMPOSE=1; shift;;
     --memory) MEMORY=1; shift;;
     --serve) SERVE=1; shift;;
+    --obs) OBS=1; shift;;
     *) break;;
   esac
 done
@@ -848,6 +850,101 @@ PYEOF
     exit 1
   fi
   echo "preflight serve clean" | tee -a "$OUT/battery.log"
+fi
+# Optional observability pre-flight (./run_tpu_battery.sh --obs [outdir]):
+# the ISSUE-19 gates, CPU-pinned — a live mini-daemon runs a warm second
+# generation while a polling thread hammers the read-only metrics/ping/
+# list ops mid-soak; the scrape must cause ZERO recompiles
+# (CompileTracker), every tenant history must stay byte-identical to an
+# unscraped reference daemon (MUR1701), and the final scrape must agree
+# with an independent replay of the durable ledger + event streams
+# (MUR1700 parity).  Spans built from a drained tenant must validate and
+# reconcile with phase_times (MUR1702).
+if [ "$OBS" = 1 ]; then
+  echo "=== preflight: observability (mid-soak scrape: zero recompiles + ledger parity) ($(date +%H:%M:%S)) ===" | tee -a "$OUT/battery.log"
+  if ! timeout 600 env JAX_PLATFORMS=cpu python - > "$OUT/preflight_obs.out" 2>&1 <<'PYEOF'
+import sys
+import tempfile
+import threading
+from pathlib import Path
+
+from murmura_tpu.analysis.observe import (
+    interference_problems,
+    metrics_ledger_parity,
+)
+from murmura_tpu.analysis.sanitizers import track_compiles
+from murmura_tpu.analysis.serve import _tenant_raw
+from murmura_tpu.config import Config
+from murmura_tpu.serve.daemon import ServeDaemon
+from murmura_tpu.telemetry.spans import build_spans, validate_spans
+from murmura_tpu.telemetry.writer import events_of_type
+
+tmp = Path(tempfile.mkdtemp(prefix="murmura-obs-preflight-"))
+
+def daemon(state):
+    cfg = Config.model_validate({
+        **_tenant_raw(seed=0, rounds=3),
+        "serve": {"state_dir": str(state), "capacity": 2,
+                  "checkpoint_every": 1},
+    })
+    return ServeDaemon(cfg)
+
+def soak(state, scrape):
+    d = daemon(state)
+    d.submit_config(_tenant_raw(seed=5))
+    d.submit_config(_tenant_raw(seed=6))
+    d.drain()  # generation 1 warms the bucket
+    gen2 = [d.submit_config(_tenant_raw(seed=7))["id"],
+            d.submit_config(_tenant_raw(seed=8))["id"]]
+    stop = threading.Event()
+    def poll():
+        while not stop.is_set():
+            d.handle_request({"op": "metrics"})
+            d.handle_request({"op": "ping"})
+            d.handle_request({"op": "list"})
+    poller = threading.Thread(target=poll, daemon=True)
+    if scrape:
+        poller.start()
+    try:
+        with track_compiles() as tracker:
+            d.drain()  # generation 2: the mid-soak scrape target
+    finally:
+        stop.set()
+        if scrape:
+            poller.join(timeout=10.0)
+    return d, gen2, tracker.total
+
+ref, ref_ids, _ = soak(tmp / "ref", scrape=False)
+scr, scr_ids, compiles = soak(tmp / "scraped", scrape=True)
+
+pairs = [
+    (i, scr._ledger[i].get("history"), ref._ledger[j].get("history"))
+    for i, j in zip(scr_ids, ref_ids)
+]
+problems = interference_problems(compiles, pairs)
+problems += metrics_ledger_parity(scr)
+for sub_id in scr_ids:
+    run_dir = scr.state_dir / "telemetry" / sub_id
+    total = sum(float(e.get("wall_s", 0.0))
+                for e in events_of_type(run_dir, "phase_times"))
+    problems += [
+        f"{sub_id}: {p}"
+        for p in validate_spans(build_spans(run_dir), phase_total=total)
+    ]
+if problems:
+    print("preflight obs FAILED:")
+    for p in problems:
+        print(" -", p)
+    sys.exit(1)
+print(f"preflight obs ok: 0 compiles under scrape, parity clean, "
+      f"{len(scr_ids)} tenants span-validated")
+PYEOF
+  then
+    echo "preflight obs FAILED — aborting battery" | tee -a "$OUT/battery.log"
+    tail -20 "$OUT/preflight_obs.out" | tee -a "$OUT/battery.log"
+    exit 1
+  fi
+  echo "preflight obs clean" | tee -a "$OUT/battery.log"
 fi
 # Optional population pre-flight (./run_tpu_battery.sh --population
 # [outdir]): the ISSUE-6 engine gates — (a) a 4096-node exponential-graph

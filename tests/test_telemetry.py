@@ -21,6 +21,7 @@ import numpy as np
 import pytest
 
 from murmura_tpu.config import Config, load_config
+from murmura_tpu.telemetry.schema import MANIFEST_SCHEMA_VERSION
 from murmura_tpu.telemetry.writer import (
     TelemetryWriter,
     events_of_type,
@@ -69,7 +70,7 @@ class TestWriter:
         w.close()
         m = read_manifest(tmp_path / "r")
         assert path.name == "manifest.json"
-        assert m["schema_version"] == 1
+        assert m["schema_version"] == MANIFEST_SCHEMA_VERSION
         assert m["run_id"] == "abc"
         assert m["finalized"] is True
         assert m["history"]["round"] == [1]
